@@ -1,0 +1,63 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Core surface (tasks/actors/objects/placement groups) mirrors the reference's
+capability set (see SURVEY.md); the accelerator data plane is JAX/XLA/Pallas.
+This module must import fast and without jax — ML layers (ray_tpu.train,
+ray_tpu.data, ray_tpu.parallel, ...) import jax lazily on first use.
+"""
+from ray_tpu._version import version as __version__
+from ray_tpu.core.api import (
+    Cluster,
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    get_async,
+    get_runtime_context,
+    init,
+    init_cluster,
+    is_initialized,
+    kill,
+    list_named_actors,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef, ObjectLostError, GetTimeoutError
+from ray_tpu.core.placement_group import PlacementGroup, placement_group, remove_placement_group
+from ray_tpu.core.serialization import RemoteError
+from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.core.worker import ActorDiedError
+
+__all__ = [
+    "ActorDiedError",
+    "Cluster",
+    "GetTimeoutError",
+    "ObjectLostError",
+    "ObjectRef",
+    "PlacementGroup",
+    "RemoteError",
+    "SchedulingStrategy",
+    "available_resources",
+    "cluster_resources",
+    "get",
+    "get_actor",
+    "get_async",
+    "get_runtime_context",
+    "init",
+    "init_cluster",
+    "is_initialized",
+    "kill",
+    "list_named_actors",
+    "nodes",
+    "placement_group",
+    "put",
+    "remote",
+    "remove_placement_group",
+    "shutdown",
+    "timeline",
+    "wait",
+]

@@ -1,0 +1,19 @@
+from ray_tpu.accel.tpu import (
+    TPUAcceleratorManager,
+    detect_tpu_resources,
+    get_chips_per_host,
+    get_num_tpu_chips,
+    get_tpu_pod_type,
+    get_tpu_slice_name,
+    get_tpu_worker_id,
+)
+
+__all__ = [
+    "TPUAcceleratorManager",
+    "detect_tpu_resources",
+    "get_chips_per_host",
+    "get_num_tpu_chips",
+    "get_tpu_pod_type",
+    "get_tpu_slice_name",
+    "get_tpu_worker_id",
+]

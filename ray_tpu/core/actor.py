@@ -1,0 +1,86 @@
+"""Actor class decorator, handles and method proxies (reference:
+/root/reference/python/ray/actor.py — ActorClass/ActorHandle/ActorMethod,
+.options(), max_restarts/max_task_retries at actor.py:382-424).
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.task_spec import ActorOptions
+from ray_tpu.core.remote_function import _apply_options
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core import api
+
+        core = api._require_worker()
+        opts = replace(self._handle._opts)
+        refs = core.submit_actor_task_sync(self._handle._actor_id, self._name, args, kwargs, self._num_returns, opts)
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, opts: ActorOptions):
+        self._actor_id = actor_id
+        self._opts = opts
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._opts))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, options: ActorOptions | None = None):
+        self._cls = cls
+        self._opts = options or ActorOptions()
+        self._cls_id: str | None = None
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def options(self, **kwargs) -> "ActorClass":
+        new_opts = _apply_options(self._opts, {k: v for k, v in kwargs.items() if k not in ("name", "namespace")})
+        clone = ActorClass(self._cls, new_opts)
+        clone._cls_id = self._cls_id
+        clone._name = kwargs.get("name", "")
+        clone._namespace = kwargs.get("namespace", "default")
+        return clone
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu.core import api
+
+        core = api._require_worker()
+        if self._cls_id is None:
+            self._cls_id = core.export_callable("cls", self._cls)
+        blob, _ = serialization.serialize((args, kwargs))
+        opts = replace(self._opts)
+        actor_id = core.create_actor_sync(
+            self._cls_id, blob, opts, name=getattr(self, "_name", ""), namespace=getattr(self, "_namespace", "default")
+        )
+        return ActorHandle(actor_id, opts)
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"actor class {self.__name__} cannot be instantiated directly; use .remote()")

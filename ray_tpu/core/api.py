@@ -1,0 +1,310 @@
+"""Public API: init/shutdown/remote/get/put/wait + cluster bootstrap.
+
+Reference equivalents: ray.init/connect (python/ray/_private/worker.py:1406,
+2437), @ray.remote dispatch (worker.py), and ray.cluster_utils.Cluster
+(python/ray/cluster_utils.py:135) — the multi-node-on-one-machine test
+harness: N in-process node daemons + one controller, with arbitrary fake
+resources per node, so multi-node scheduling (including fake TPU slices) is
+testable with zero TPUs (SURVEY §4).
+"""
+from __future__ import annotations
+
+import asyncio
+import atexit
+import inspect
+import threading
+import time
+from typing import Any, Optional, Sequence
+
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.config import Config, get_config
+from ray_tpu.core.controller import Controller
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.node import NodeDaemon
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.task_spec import ActorOptions, TaskOptions
+from ray_tpu.core.worker import ActorDiedError, CoreWorker
+
+_global_worker: CoreWorker | None = None
+_global_cluster: "Cluster | None" = None
+
+
+class _ServiceHost:
+    """Runs controller/daemons on a dedicated asyncio loop thread."""
+
+    def __init__(self, name="raytpu-services"):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def call(self, coro, timeout=30.0):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def stop(self):
+        async def drain():
+            tasks = [t for t in asyncio.all_tasks() if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        try:
+            self.call(drain(), timeout=2)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+class Cluster:
+    """Multi-node cluster on one machine (reference: cluster_utils.Cluster)."""
+
+    def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None, config: Config | None = None):
+        self.config = config or get_config()
+        self.host = _ServiceHost()
+        self.controller = Controller(self.config)
+        self.controller_addr = self.host.call(self.controller.start())
+        self.daemons: list[NodeDaemon] = []
+        if initialize_head:
+            self.add_node(**(head_node_args or {}))
+
+    @property
+    def address(self) -> str:
+        return self.controller_addr
+
+    def add_node(
+        self,
+        num_cpus: float | None = None,
+        resources: dict | None = None,
+        labels: dict | None = None,
+        env: dict | None = None,
+        object_store_memory: int | None = None,
+        **kw,
+    ) -> NodeDaemon:
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res.setdefault("CPU", float(num_cpus))
+        elif "CPU" not in res:
+            res["CPU"] = 4.0
+        daemon = NodeDaemon(
+            self.controller_addr,
+            config=self.config,
+            resources=res,
+            labels=labels,
+            env=env,
+            store_capacity=object_store_memory,
+            # Hermetic by default: fake clusters advertise exactly what the
+            # test passes, even on a real TPU host (kw override for prod).
+            autodetect_accelerators=kw.get("autodetect_accelerators", False),
+        )
+        self.host.call(daemon.start())
+        self.daemons.append(daemon)
+        return daemon
+
+    def remove_node(self, daemon: NodeDaemon):
+        if daemon in self.daemons:
+            self.daemons.remove(daemon)
+        self.host.call(daemon.stop())
+
+    def shutdown(self):
+        for d in list(self.daemons):
+            try:
+                self.host.call(d.stop())
+            except Exception:
+                pass
+        self.daemons.clear()
+        try:
+            self.host.call(self.controller.stop())
+        except Exception:
+            pass
+        self.host.stop()
+
+
+def init(
+    address: str | None = None,
+    num_cpus: float | None = None,
+    resources: dict | None = None,
+    labels: dict | None = None,
+    object_store_memory: int | None = None,
+    config: Config | None = None,
+) -> dict:
+    """Start (or connect to) a cluster and create the driver's CoreWorker."""
+    global _global_worker, _global_cluster
+    if _global_worker is not None:
+        return {"address": _global_worker.controller_addr}
+    cfg = config or get_config()
+    if address is None:
+        _global_cluster = Cluster(
+            initialize_head=True,
+            head_node_args={
+                "num_cpus": num_cpus,
+                "resources": resources,
+                "labels": labels,
+                "object_store_memory": object_store_memory,
+            },
+            config=cfg,
+        )
+        address = _global_cluster.address
+    worker = CoreWorker(mode="driver", controller_addr=address, config=cfg)
+    worker.start_driver_sync()
+    _global_worker = worker
+    atexit.register(shutdown)
+    return {"address": address}
+
+
+def init_cluster(cluster: Cluster) -> dict:
+    """Connect the driver to an existing in-process Cluster (tests)."""
+    return init(address=cluster.address)
+
+
+def shutdown():
+    global _global_worker, _global_cluster
+    if _global_worker is not None:
+        _global_worker.shutdown_sync()
+        _global_worker = None
+    if _global_cluster is not None:
+        _global_cluster.shutdown()
+        _global_cluster = None
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None
+
+
+def _set_global_worker(worker: CoreWorker):
+    global _global_worker
+    _global_worker = worker
+
+
+def _require_worker() -> CoreWorker:
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu not initialized; call ray_tpu.init() first")
+    return _global_worker
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes."""
+
+    def wrap(obj):
+        if inspect.isclass(obj):
+            opts = ActorOptions()
+            from ray_tpu.core.remote_function import _apply_options
+
+            return ActorClass(obj, _apply_options(opts, kwargs))
+        opts = TaskOptions()
+        from ray_tpu.core.remote_function import _apply_options
+
+        return RemoteFunction(obj, _apply_options(opts, kwargs))
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or inspect.isclass(args[0])):
+        return wrap(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return wrap
+
+
+def get(refs, timeout: float | None = None):
+    return _require_worker().get_sync(refs, timeout=timeout)
+
+
+async def get_async(ref: ObjectRef):
+    core = _require_worker()
+    fut = asyncio.run_coroutine_threadsafe(core._get_many([ref]), core.loop)
+    result = await asyncio.wrap_future(fut)
+    return result[0]
+
+
+def put(value) -> ObjectRef:
+    return _require_worker().put_sync(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1, timeout: float | None = None):
+    return _require_worker().wait_sync(list(refs), num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _require_worker().kill_actor_sync(actor._actor_id, no_restart=no_restart)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    core = _require_worker()
+    info = core._run(core.controller.call("get_actor", {"name": name, "namespace": namespace}))
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r} in namespace {namespace!r}")
+    aid = ActorID(info["actor_id"])
+    core._actor_conns.setdefault(aid, {"addr": info["worker_addr"], "conn": None, "seq": 0})
+    return ActorHandle(aid, ActorOptions())
+
+
+def list_named_actors(namespace: str | None = None) -> list[dict]:
+    core = _require_worker()
+    return core._run(core.controller.call("list_named_actors", {"namespace": namespace}))
+
+
+def cluster_resources() -> dict:
+    state = _cluster_state()
+    total: dict = {}
+    for n in state["nodes"].values():
+        if n["state"] == "ALIVE":
+            for k, v in n["resources_total"].items():
+                total[k] = total.get(k, 0) + v
+    return total
+
+
+def available_resources() -> dict:
+    state = _cluster_state()
+    total: dict = {}
+    for n in state["nodes"].values():
+        if n["state"] == "ALIVE":
+            for k, v in n["resources_available"].items():
+                total[k] = total.get(k, 0) + v
+    return total
+
+
+def nodes() -> list[dict]:
+    state = _cluster_state()
+    return [{"NodeID": nid, **info} for nid, info in state["nodes"].items()]
+
+
+def _cluster_state() -> dict:
+    core = _require_worker()
+    return core._run(core.controller.call("get_cluster_state", {}))
+
+
+def timeline() -> list[dict]:
+    core = _require_worker()
+    events = core._run(core.controller.call("get_events", {}))
+    return events + core.task_events
+
+
+class RuntimeContext:
+    def __init__(self, core: CoreWorker):
+        self._core = core
+
+    @property
+    def job_id(self):
+        return self._core.job_id
+
+    @property
+    def node_id(self):
+        return self._core.node_id
+
+    @property
+    def worker_id(self):
+        return self._core.worker_id
+
+    def get_actor_id(self):
+        rt = self._core._actor_runtime
+        return rt.spec.actor_id.hex() if rt else None
+
+    def current_actor_name(self):
+        rt = self._core._actor_runtime
+        return rt.spec.name if rt else None
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_require_worker())

@@ -1,0 +1,102 @@
+"""Typed config flags with environment-variable overrides.
+
+TPU-native analogue of the reference's RAY_CONFIG macro system
+(/root/reference/src/ray/common/ray_config_def.h): every flag is declared once
+with a type and default, and can be overridden with a ``RAYTPU_<NAME>``
+environment variable. The head node's config is propagated to all nodes via
+the controller KV at startup (see controller.py), matching the reference's
+head-config propagation (/root/reference/python/ray/_private/node.py:1338).
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RAYTPU_"
+
+
+def _coerce(ty, raw: str):
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    if ty in (dict, list):
+        return json.loads(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    # --- transport / rpc ---
+    heartbeat_interval_s: float = 0.5
+    # Generous: worker-spawn bursts can starve the event loop on small hosts;
+    # TCP connection loss catches hard failures much sooner anyway.
+    heartbeat_timeout_s: float = 15.0
+    rpc_connect_timeout_s: float = 10.0
+    rpc_retry_delay_s: float = 0.1
+    # --- objects ---
+    # Objects at or below this many bytes are inlined in RPC replies instead of
+    # going through the shared-memory store (reference: max_direct_call_object_size,
+    # ray_config_def.h).
+    max_inline_object_size: int = 100 * 1024
+    object_store_memory: int = 256 * 1024 * 1024
+    object_chunk_size: int = 1024 * 1024
+    object_spill_dir: str = ""
+    # --- workers ---
+    num_workers_soft_limit: int = 0  # 0 => num_cpus
+    worker_register_timeout_s: float = 30.0
+    worker_start_timeout_s: float = 60.0
+    idle_worker_killing_time_s: float = 300.0
+    # --- scheduling ---
+    scheduler_spread_threshold: float = 0.5
+    max_pending_lease_requests_per_key: int = 10
+    # --- actors ---
+    actor_creation_timeout_s: float = 60.0
+    max_actor_restarts_default: int = 0
+    # --- failure handling ---
+    task_retry_delay_s: float = 0.05
+    max_task_retries_default: int = 3
+    lineage_max_bytes: int = 64 * 1024 * 1024
+    # --- logging/metrics ---
+    log_dir: str = ""
+    metrics_report_interval_s: float = 5.0
+    event_buffer_size: int = 10000
+    # --- tpu ---
+    tpu_chips_per_host_default: int = 4
+
+    def apply_env(self):
+        for f in fields(self):
+            raw = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if raw is not None:
+                setattr(self, f.name, _coerce(f.type if isinstance(f.type, type) else type(getattr(self, f.name)), raw))
+        return self
+
+    def to_dict(self) -> dict:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        cfg = cls()
+        for k, v in d.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config().apply_env()
+    return _global_config
+
+
+def set_config(cfg: Config):
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,845 @@
+"""Cluster controller: the control plane (GCS equivalent).
+
+Role-equivalent to the reference's GCS server (/root/reference/src/ray/gcs/
+gcs_server.cc and friends): node table + health checks (GcsNodeManager /
+GcsHealthCheckManager), actor lifecycle FSM (GcsActorManager,
+gcs_actor_manager.h:48-76), placement groups (GcsPlacementGroupManager),
+internal KV (GcsKvManager), pubsub (InternalPubSubGcsService), job table
+(GcsJobManager), and the cluster resource view (GcsResourceManager +
+ray_syncer). One deliberate architectural departure for the TPU build: task
+scheduling is *central* — the controller holds the single resource ledger and
+grants leases directly, instead of the reference's distributed
+raylet-to-raylet spillback scheduling (cluster_lease_manager.cc). A TPU pod
+is a mostly-static gang-scheduled domain, so a central ledger gives atomic
+gang reservation (what the reference needs 2-phase commit across raylets
+for) and strictly simpler failure semantics, at the cost of a scalability
+ceiling that a pod-sized cluster does not hit.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+
+logger = logging.getLogger(__name__)
+
+# Actor FSM states (reference: gcs_actor_manager.h:48-76).
+PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+@dataclass
+class NodeRecord:
+    node_id: str
+    address: str  # daemon rpc address
+    resources_total: dict
+    resources_available: dict
+    labels: dict
+    store_path: str
+    conn: Any = None
+    last_heartbeat: float = 0.0
+    state: str = "ALIVE"
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    spec: Any  # ActorSpec
+    state: str = PENDING
+    node_id: str = ""
+    worker_addr: str = ""
+    worker_id: str = ""
+    restarts_used: int = 0
+    death_cause: str = ""
+    pending_waiters: list = field(default_factory=list)
+
+
+@dataclass
+class BundleState:
+    index: int
+    resources: dict
+    node_id: str = ""
+    available: dict = field(default_factory=dict)
+
+
+@dataclass
+class PGRecord:
+    pg_id: PlacementGroupID
+    bundles: list  # [BundleState]
+    strategy: str
+    state: str = "PENDING"
+    name: str = ""
+    job_id: Optional[JobID] = None
+    pending_waiters: list = field(default_factory=list)
+
+
+@dataclass
+class PendingLease:
+    lease_id: str
+    demand: dict
+    strategy: Any
+    label_selector: dict
+    future: asyncio.Future
+    job_id: Optional[str] = None
+    conn: Any = None
+
+
+def _fits(avail: dict, demand: dict) -> bool:
+    return all(avail.get(k, 0) + 1e-9 >= v for k, v in demand.items())
+
+
+def _sub(avail: dict, demand: dict):
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0) - v
+
+
+def _add(avail: dict, demand: dict):
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0) + v
+
+
+def _labels_match(labels: dict, selector: dict) -> bool:
+    """Label selector semantics (reference: common/scheduling/label_selector.h):
+    values "v" (equals), "!v" (not equals), "in(a,b)", "!in(a,b")."""
+    for key, cond in selector.items():
+        val = labels.get(key)
+        if cond.startswith("!in(") and cond.endswith(")"):
+            if val is not None and str(val) in cond[4:-1].split(","):
+                return False
+        elif cond.startswith("in(") and cond.endswith(")"):
+            if val is None or str(val) not in cond[3:-1].split(","):
+                return False
+        elif cond.startswith("!"):
+            if val is not None and str(val) == cond[1:]:
+                return False
+        else:
+            if val is None or str(val) != cond:
+                return False
+    return True
+
+
+class Controller:
+    def __init__(self, config: Config, host: str = "127.0.0.1"):
+        self.config = config
+        self.server = rpc.RpcServer(self, host=host)
+        self.nodes: dict[str, NodeRecord] = {}
+        self.kv: dict[str, dict[str, bytes]] = {}  # namespace -> {key: value}
+        self.actors: dict[ActorID, ActorRecord] = {}
+        self.named_actors: dict[tuple[str, str], ActorID] = {}
+        self.pgs: dict[PlacementGroupID, PGRecord] = {}
+        self.leases: dict[str, tuple[str, dict, Any, Any]] = {}  # lease_id -> (node_id, demand, strategy, owner_conn)
+        self.pending_leases: list[PendingLease] = []
+        self.object_dir: dict[bytes, set[str]] = {}  # oid bytes -> node ids
+        self.object_sizes: dict[bytes, int] = {}
+        self.subscribers: dict[str, set] = {}  # channel -> conns
+        self.jobs: dict[str, dict] = {}
+        self._job_counter = 0
+        self._rr_counter = 0
+        self._bg: list[asyncio.Task] = []
+        self.events: list[dict] = []  # structured event log (ray_event_recorder equiv)
+
+    # ------------------------------------------------------------------
+    async def start(self, port: int = 0) -> str:
+        addr = await self.server.start(port)
+        self._bg.append(asyncio.create_task(self._health_check_loop()))
+        logger.info("controller listening on %s", addr)
+        return addr
+
+    async def stop(self):
+        for t in self._bg:
+            t.cancel()
+        await self.server.close()
+
+    def _event(self, kind: str, **kw):
+        self.events.append({"ts": time.time(), "kind": kind, **kw})
+        if len(self.events) > self.config.event_buffer_size:
+            del self.events[: len(self.events) // 2]
+
+    # -- pubsub ---------------------------------------------------------
+    def handle_subscribe(self, conn, p):
+        self.subscribers.setdefault(p["channel"], set()).add(conn)
+        conn.on_close = self._make_close_cb(conn)
+        return True
+
+    def handle_unsubscribe(self, conn, p):
+        self.subscribers.get(p["channel"], set()).discard(conn)
+        return True
+
+    def publish(self, channel: str, key: str, data: Any):
+        dead = []
+        for conn in self.subscribers.get(channel, ()):  # push-based; the
+            # reference uses long-polling (pubsub/publisher.h:233) because gRPC
+            # streams were historically avoided; symmetric sockets let us push.
+            if conn.closed:
+                dead.append(conn)
+                continue
+            asyncio.create_task(self._safe_notify(conn, channel, key, data))
+        for c in dead:
+            self.subscribers[channel].discard(c)
+
+    async def _safe_notify(self, conn, channel, key, data):
+        try:
+            await conn.notify("pub", {"channel": channel, "key": key, "data": data})
+        except Exception:
+            pass
+
+    # -- connection lifecycle ------------------------------------------
+    def on_connection(self, conn):
+        conn.on_close = self._make_close_cb(conn)
+
+    def _make_close_cb(self, conn):
+        def cb(c):
+            for subs in self.subscribers.values():
+                subs.discard(c)
+            role = c.meta.get("role")
+            try:
+                self._release_leases_of(c)
+                if role == "daemon":
+                    node_id = c.meta.get("node_id")
+                    if node_id in self.nodes:
+                        asyncio.create_task(self._on_node_dead(node_id, "daemon disconnected"))
+                elif role == "driver":
+                    asyncio.create_task(self._on_driver_exit(c.meta.get("job_id")))
+            except RuntimeError:
+                pass  # loop already shutting down
+
+        return cb
+
+    # -- node management ------------------------------------------------
+    async def handle_register_node(self, conn, p):
+        node = NodeRecord(
+            node_id=p["node_id"],
+            address=p["address"],
+            resources_total=dict(p["resources"]),
+            resources_available=dict(p["resources"]),
+            labels=p.get("labels", {}),
+            store_path=p.get("store_path", ""),
+            conn=conn,
+            last_heartbeat=time.monotonic(),
+        )
+        conn.meta.update(role="daemon", node_id=p["node_id"])
+        self.nodes[p["node_id"]] = node
+        self._event("node_alive", node_id=p["node_id"], resources=p["resources"])
+        self.publish("node", p["node_id"], {"state": "ALIVE", "address": p["address"]})
+        await self._retry_pending()
+        return {"config": self.config.to_dict(), "nodes": self._node_table()}
+
+    def _node_table(self):
+        return {
+            nid: {
+                "address": n.address,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+                "labels": n.labels,
+                "store_path": n.store_path,
+                "state": n.state,
+            }
+            for nid, n in self.nodes.items()
+        }
+
+    def handle_heartbeat(self, conn, p):
+        node = self.nodes.get(p["node_id"])
+        if node:
+            node.last_heartbeat = time.monotonic()
+        return True
+
+    def handle_get_cluster_state(self, conn, p):
+        return {
+            "nodes": self._node_table(),
+            "actors": {
+                a.actor_id.hex(): {
+                    "state": a.state,
+                    "node_id": a.node_id,
+                    "worker_addr": a.worker_addr,
+                    "name": a.spec.name,
+                    "restarts": a.restarts_used,
+                    "class": a.spec.cls_id,
+                }
+                for a in self.actors.values()
+            },
+            "placement_groups": {
+                pg.pg_id.hex(): {
+                    "state": pg.state,
+                    "strategy": pg.strategy,
+                    "bundles": [{"index": b.index, "resources": b.resources, "node_id": b.node_id} for b in pg.bundles],
+                }
+                for pg in self.pgs.values()
+            },
+            "jobs": self.jobs,
+            "objects": {"count": len(self.object_dir), "bytes": sum(self.object_sizes.values())},
+        }
+
+    def handle_get_events(self, conn, p):
+        return self.events[-int(p.get("limit", 1000)):]
+
+    async def _health_check_loop(self):
+        # Reference: GcsHealthCheckManager gRPC-probes raylets; here liveness
+        # is daemon->controller heartbeats plus TCP connection state.
+        while True:
+            await asyncio.sleep(self.config.heartbeat_interval_s)
+            now = time.monotonic()
+            for nid, node in list(self.nodes.items()):
+                if node.state == "ALIVE" and now - node.last_heartbeat > self.config.heartbeat_timeout_s:
+                    await self._on_node_dead(nid, "heartbeat timeout")
+
+    async def _on_node_dead(self, node_id: str, reason: str):
+        node = self.nodes.get(node_id)
+        if node is None or node.state == "DEAD":
+            return
+        node.state = "DEAD"
+        node.resources_available = {}
+        self._event("node_dead", node_id=node_id, reason=reason)
+        logger.warning("node %s dead: %s", node_id[:8], reason)
+        self.publish("node", node_id, {"state": "DEAD", "reason": reason})
+        # Objects on that node are gone from the directory.
+        for oid, nodes in list(self.object_dir.items()):
+            nodes.discard(node_id)
+            if not nodes:
+                del self.object_dir[oid]
+        # Fail/restart actors that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ALIVE, PENDING, RESTARTING):
+                await self._on_actor_worker_died(actor, f"node died: {reason}")
+        # Leases on the node are void.
+        for lease_id, (nid, demand, _strategy, _owner) in list(self.leases.items()):
+            if nid == node_id:
+                del self.leases[lease_id]
+        # PG bundles on that node: mark pg for reschedule (round 1: mark DEAD).
+        for pg in self.pgs.values():
+            if pg.state == "CREATED" and any(b.node_id == node_id for b in pg.bundles):
+                pg.state = "RESCHEDULING"
+                asyncio.create_task(self._schedule_pg(pg))
+
+    async def _on_driver_exit(self, job_id):
+        if job_id is None:
+            return
+        self.jobs.get(job_id, {}).update(state="DEAD")
+        self._event("job_finished", job_id=job_id)
+        # Kill non-detached actors belonging to the job.
+        for actor in list(self.actors.values()):
+            if actor.spec.job_id.hex() == job_id and actor.spec.options.lifetime != "detached" and actor.state != DEAD:
+                await self._kill_actor(actor, "driver exited", no_restart=True)
+        for pg in list(self.pgs.values()):
+            if pg.job_id is not None and pg.job_id.hex() == job_id:
+                await self._remove_pg(pg)
+
+    # -- job management -------------------------------------------------
+    def handle_register_job(self, conn, p):
+        self._job_counter += 1
+        job_id = JobID.from_int(self._job_counter)
+        conn.meta.update(role="driver", job_id=job_id.hex())
+        self.jobs[job_id.hex()] = {"state": "RUNNING", "driver_addr": p.get("driver_addr", ""), "start_ts": time.time()}
+        self._event("job_started", job_id=job_id.hex())
+        return {"job_id": job_id.binary(), "config": self.config.to_dict(), "nodes": self._node_table()}
+
+    # -- KV -------------------------------------------------------------
+    def handle_kv_put(self, conn, p):
+        ns = self.kv.setdefault(p.get("ns", ""), {})
+        exists = p["key"] in ns
+        if not exists or p.get("overwrite", True):
+            ns[p["key"]] = p["value"]
+        return not exists
+
+    def handle_kv_get(self, conn, p):
+        return self.kv.get(p.get("ns", ""), {}).get(p["key"])
+
+    def handle_kv_multi_get(self, conn, p):
+        ns = self.kv.get(p.get("ns", ""), {})
+        return {k: ns.get(k) for k in p["keys"]}
+
+    def handle_kv_del(self, conn, p):
+        return self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None
+
+    def handle_kv_keys(self, conn, p):
+        prefix = p.get("prefix", "")
+        return [k for k in self.kv.get(p.get("ns", ""), {}) if k.startswith(prefix)]
+
+    # -- scheduling core ------------------------------------------------
+    def _feasible_nodes(self, demand: dict, label_selector: dict) -> list[NodeRecord]:
+        return [
+            n
+            for n in self.nodes.values()
+            if n.state == "ALIVE"
+            and _labels_match(n.labels, label_selector)
+            and all(n.resources_total.get(k, 0) + 1e-9 >= v for k, v in demand.items())
+        ]
+
+    def _pick_node(self, demand: dict, strategy, label_selector: dict) -> Optional[NodeRecord]:
+        """Scheduling policies (reference: raylet/scheduling/policy/*):
+        DEFAULT = hybrid pack-below-threshold-then-spread
+        (hybrid_scheduling_policy.h:50), SPREAD = round-robin least-loaded
+        (spread_scheduling_policy), NODE_AFFINITY, PLACEMENT_GROUP bundle."""
+        kind = getattr(strategy, "kind", "DEFAULT")
+        if kind == "PLACEMENT_GROUP":
+            pg = self.pgs.get(strategy.placement_group)
+            if pg is None or pg.state != "CREATED":
+                return None
+            idxs = [strategy.bundle_index] if strategy.bundle_index >= 0 else range(len(pg.bundles))
+            for i in idxs:
+                b = pg.bundles[i]
+                node = self.nodes.get(b.node_id)
+                if node and node.state == "ALIVE" and _fits(b.available, demand):
+                    return node
+            return None
+        if kind == "NODE_AFFINITY":
+            node = self.nodes.get(strategy.node_id)
+            if node and node.state == "ALIVE" and _fits(node.resources_available, demand):
+                return node
+            if getattr(strategy, "soft", False):
+                pass  # fall through to default policy
+            else:
+                return None
+        feasible = [n for n in self._feasible_nodes(demand, label_selector) if _fits(n.resources_available, demand)]
+        if not feasible:
+            return None
+        feasible.sort(key=lambda n: n.node_id)
+
+        def utilization(n: NodeRecord) -> float:
+            fracs = [
+                1 - n.resources_available.get(k, 0) / t for k, t in n.resources_total.items() if t > 0
+            ]
+            return max(fracs) if fracs else 0.0
+
+        if kind == "SPREAD":
+            self._rr_counter += 1
+            feasible.sort(key=utilization)
+            return feasible[(self._rr_counter) % max(1, len([n for n in feasible if utilization(n) == utilization(feasible[0])]))]
+        below = [n for n in feasible if utilization(n) < self.config.scheduler_spread_threshold]
+        if below:
+            return max(below, key=utilization)  # pack: most-utilized below threshold
+        return min(feasible, key=utilization)  # spread: least utilized
+
+    def _consume(self, node: NodeRecord, demand: dict, strategy=None):
+        _sub(node.resources_available, demand)
+        if strategy is not None and getattr(strategy, "kind", "") == "PLACEMENT_GROUP":
+            pg = self.pgs.get(strategy.placement_group)
+            if pg:
+                idxs = [strategy.bundle_index] if strategy.bundle_index >= 0 else range(len(pg.bundles))
+                for i in idxs:
+                    b = pg.bundles[i]
+                    if b.node_id == node.node_id and _fits(b.available, demand):
+                        _sub(b.available, demand)
+                        break
+
+    def _restore(self, node_id: str, demand: dict, strategy=None):
+        node = self.nodes.get(node_id)
+        if node and node.state == "ALIVE":
+            _add(node.resources_available, demand)
+        if strategy is not None and getattr(strategy, "kind", "") == "PLACEMENT_GROUP":
+            pg = self.pgs.get(strategy.placement_group)
+            if pg:
+                idxs = [strategy.bundle_index] if strategy.bundle_index >= 0 else range(len(pg.bundles))
+                for i in idxs:
+                    b = pg.bundles[i]
+                    if b.node_id == node_id:
+                        _add(b.available, demand)
+                        break
+
+    async def handle_request_lease(self, conn, p):
+        """Grant a worker lease: returns node address once resources free up.
+
+        Reference flow: NormalTaskSubmitter::RequestNewWorkerIfNeeded ->
+        raylet HandleRequestWorkerLease -> ClusterLeaseManager queue
+        (node_manager.cc:1786); here the queue lives in the controller.
+        """
+        strategy = p["strategy"]
+        demand = p["demand"]
+        node = self._pick_node(demand, strategy, p.get("label_selector", {}))
+        if node is not None:
+            self._consume(node, demand, strategy)
+            self.leases[p["lease_id"]] = (node.node_id, demand, strategy, conn)
+            return {"node_id": node.node_id, "address": node.address, "store_path": node.store_path, "strategy": strategy}
+        if not self._feasible_nodes(demand, p.get("label_selector", {})) and getattr(strategy, "kind", "") != "PLACEMENT_GROUP":
+            return {"infeasible": True}
+        fut = asyncio.get_running_loop().create_future()
+        pl = PendingLease(p["lease_id"], demand, strategy, p.get("label_selector", {}), fut)
+        pl.conn = conn
+        self.pending_leases.append(pl)
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            if pl in self.pending_leases:
+                self.pending_leases.remove(pl)
+            raise
+
+    def handle_release_lease(self, conn, p):
+        entry = self.leases.pop(p["lease_id"], None)
+        if entry:
+            node_id, demand, strategy, _owner = entry
+            self._restore(node_id, demand, p.get("strategy", strategy))
+            asyncio.create_task(self._retry_pending())
+        return True
+
+    def _release_leases_of(self, conn):
+        """A submitter (driver or worker) disconnected: return its granted
+        resources and drop its queued lease requests."""
+        released = False
+        for lease_id, (node_id, demand, strategy, owner) in list(self.leases.items()):
+            if owner is conn:
+                del self.leases[lease_id]
+                self._restore(node_id, demand, strategy)
+                released = True
+        for pl in list(self.pending_leases):
+            if getattr(pl, "conn", None) is conn:
+                self.pending_leases.remove(pl)
+        if released:
+            asyncio.create_task(self._retry_pending())
+
+    async def _retry_pending(self):
+        granted = True
+        while granted and self.pending_leases:
+            granted = False
+            for pl in list(self.pending_leases):
+                node = self._pick_node(pl.demand, pl.strategy, pl.label_selector)
+                if node is not None:
+                    self.pending_leases.remove(pl)
+                    self._consume(node, pl.demand, pl.strategy)
+                    self.leases[pl.lease_id] = (node.node_id, pl.demand, pl.strategy, getattr(pl, "conn", None))
+                    if not pl.future.done():
+                        pl.future.set_result(
+                            {"node_id": node.node_id, "address": node.address, "store_path": node.store_path, "strategy": pl.strategy}
+                        )
+                    granted = True
+
+    # -- actors ---------------------------------------------------------
+    async def handle_register_actor(self, conn, p):
+        spec = p["spec"]
+        if spec.name:
+            key = (spec.namespace, spec.name)
+            if key in self.named_actors:
+                existing = self.actors[self.named_actors[key]]
+                if existing.state != DEAD:
+                    if spec.options.get_if_exists:
+                        return self._actor_info(existing)
+                    raise ValueError(f"actor name {spec.name!r} already taken in namespace {spec.namespace!r}")
+            self.named_actors[key] = spec.actor_id
+        record = ActorRecord(actor_id=spec.actor_id, spec=spec)
+        self.actors[spec.actor_id] = record
+        self._event("actor_registered", actor_id=spec.actor_id.hex(), name=spec.name)
+        # Creation is asynchronous: the handle is usable immediately and the
+        # first method call blocks on wait_actor_alive (reference:
+        # GcsActorManager registration is async from the caller's view).
+        asyncio.create_task(self._schedule_actor(record))
+        return self._actor_info(record)
+
+    async def _actor_info_when_alive(self, record: ActorRecord):
+        if record.state == ALIVE:
+            return self._actor_info(record)
+        if record.state == DEAD:
+            return self._actor_info(record)
+        fut = asyncio.get_running_loop().create_future()
+        record.pending_waiters.append(fut)
+        return await fut
+
+    def _actor_info(self, record: ActorRecord):
+        return {
+            "actor_id": record.actor_id.binary(),
+            "state": record.state,
+            "worker_addr": record.worker_addr,
+            "node_id": record.node_id,
+            "death_cause": record.death_cause,
+        }
+
+    def _wake_actor_waiters(self, record: ActorRecord):
+        info = self._actor_info(record)
+        for fut in record.pending_waiters:
+            if not fut.done():
+                fut.set_result(info)
+        record.pending_waiters.clear()
+        self.publish("actor", record.actor_id.hex(), info)
+
+    async def _schedule_actor(self, record: ActorRecord):
+        spec = record.spec
+        demand = spec.options.resource_demand()
+        strategy = spec.options.scheduling_strategy
+        deadline = time.monotonic() + self.config.actor_creation_timeout_s
+        while time.monotonic() < deadline:
+            if record.state == DEAD:
+                return  # killed while pending; don't resurrect
+            node = self._pick_node(demand, strategy, spec.options.label_selector)
+            if node is None:
+                # Stay pending while demand is (even permanently) unsatisfied —
+                # a node may join; the reference likewise parks actors as
+                # PENDING_CREATION and only warns (gcs_actor_manager.h FSM).
+                await asyncio.sleep(0.05)
+                continue
+            self._consume(node, demand, strategy)
+            record.node_id = node.node_id
+            try:
+                reply = await node.conn.call("start_actor", {"spec": spec}, timeout=self.config.actor_creation_timeout_s)
+                if record.state == DEAD:  # killed during creation
+                    self._restore(node.node_id, demand, strategy)
+                    try:
+                        await node.conn.call("kill_worker", {"worker_id": reply["worker_id"], "reason": "actor killed"}, timeout=5)
+                    except Exception:
+                        pass
+                    return
+                record.worker_addr = reply["worker_addr"]
+                record.worker_id = reply["worker_id"]
+                record.state = ALIVE
+                self._event("actor_alive", actor_id=record.actor_id.hex(), node=node.node_id)
+                self._wake_actor_waiters(record)
+                return
+            except Exception as e:
+                self._restore(node.node_id, demand, strategy)
+                record.node_id = ""
+                logger.warning("actor %s creation on %s failed: %s", record.actor_id.hex()[:8], node.node_id[:8], e)
+                await asyncio.sleep(0.1)
+        record.state = DEAD
+        record.death_cause = "actor creation timed out"
+        self._wake_actor_waiters(record)
+
+    async def _on_actor_worker_died(self, record: ActorRecord, reason: str):
+        if record.state == DEAD:
+            return
+        self._restore(record.node_id, record.spec.options.resource_demand(), record.spec.options.scheduling_strategy)
+        record.node_id = ""
+        record.worker_addr = ""
+        max_restarts = record.spec.options.max_restarts
+        if max_restarts == -1 or record.restarts_used < max_restarts:
+            record.restarts_used += 1
+            record.state = RESTARTING
+            self._event("actor_restarting", actor_id=record.actor_id.hex(), attempt=record.restarts_used)
+            self.publish("actor", record.actor_id.hex(), self._actor_info(record))
+            await self._schedule_actor(record)
+        else:
+            record.state = DEAD
+            record.death_cause = reason
+            self._event("actor_dead", actor_id=record.actor_id.hex(), reason=reason)
+            self._wake_actor_waiters(record)
+        await self._retry_pending()
+
+    async def handle_worker_died(self, conn, p):
+        """Daemon reports a worker process exit (reference: raylet notifies GCS,
+        GcsActorManager::OnWorkerDead)."""
+        for actor_id_bin in p.get("actor_ids", []):
+            record = self.actors.get(ActorID(actor_id_bin))
+            if record is not None:
+                await self._on_actor_worker_died(record, p.get("reason", "worker died"))
+        return True
+
+    def handle_get_actor(self, conn, p):
+        if "name" in p:
+            aid = self.named_actors.get((p.get("namespace", "default"), p["name"]))
+            if aid is None:
+                return None
+            record = self.actors.get(aid)
+        else:
+            record = self.actors.get(ActorID(p["actor_id"]))
+        if record is None:
+            return None
+        info = self._actor_info(record)
+        info["spec"] = record.spec if p.get("with_spec") else None
+        return info
+
+    async def handle_wait_actor_alive(self, conn, p):
+        record = self.actors.get(ActorID(p["actor_id"]))
+        if record is None:
+            return None
+        if record.state in (ALIVE, DEAD):
+            return self._actor_info(record)
+        fut = asyncio.get_running_loop().create_future()
+        record.pending_waiters.append(fut)
+        return await fut
+
+    async def handle_kill_actor(self, conn, p):
+        record = self.actors.get(ActorID(p["actor_id"]))
+        if record is None:
+            return False
+        await self._kill_actor(record, "killed via controller", no_restart=p.get("no_restart", True))
+        return True
+
+    async def _kill_actor(self, record: ActorRecord, reason: str, no_restart: bool):
+        if record.state == DEAD:
+            return
+        node = self.nodes.get(record.node_id)
+        if no_restart:
+            record.spec.options.max_restarts = 0
+        if node and node.conn and not node.conn.closed:
+            try:
+                await node.conn.call("kill_worker", {"worker_id": record.worker_id, "reason": reason}, timeout=5)
+            except Exception:
+                pass
+        if no_restart and record.state != DEAD:
+            # Only restore if the actor was actually placed; a kill racing an
+            # in-flight start_actor is handled by _schedule_actor's post-reply
+            # DEAD check (which restores exactly once).
+            if record.node_id and record.worker_addr:
+                self._restore(record.node_id, record.spec.options.resource_demand(), record.spec.options.scheduling_strategy)
+            record.state = DEAD
+            record.death_cause = reason
+            self._event("actor_dead", actor_id=record.actor_id.hex(), reason=reason)
+            self._wake_actor_waiters(record)
+            await self._retry_pending()
+
+    def handle_list_named_actors(self, conn, p):
+        ns = p.get("namespace")
+        return [
+            {"namespace": k[0], "name": k[1]}
+            for k, aid in self.named_actors.items()
+            if (ns is None or k[0] == ns) and self.actors[aid].state != DEAD
+        ]
+
+    # -- placement groups ----------------------------------------------
+    async def handle_create_placement_group(self, conn, p):
+        pg = PGRecord(
+            pg_id=p["pg_id"],
+            bundles=[BundleState(i, dict(b), available=dict(b)) for i, b in enumerate(p["bundles"])],
+            strategy=p["strategy"],
+            name=p.get("name", ""),
+            job_id=p.get("job_id"),
+        )
+        self.pgs[pg.pg_id] = pg
+        await self._schedule_pg(pg)
+        if pg.state == "CREATED":
+            return {"state": pg.state, "bundle_nodes": [b.node_id for b in pg.bundles]}
+        if p.get("wait", False):
+            fut = asyncio.get_running_loop().create_future()
+            pg.pending_waiters.append(fut)
+            return await fut
+        return {"state": pg.state}
+
+    async def _schedule_pg(self, pg: PGRecord):
+        """Gang-reserve all bundles atomically on the central ledger
+        (reference: GcsPlacementGroupScheduler 2PC across raylets,
+        bundle_scheduling_policy.h:73-97 for PACK/SPREAD/STRICT_*)."""
+        assignment = self._plan_bundles(pg)
+        if assignment is None:
+            pg.state = "PENDING"
+            asyncio.create_task(self._pg_retry_loop(pg))
+            return
+        for b, node in zip(pg.bundles, assignment):
+            _sub(node.resources_available, b.resources)
+            b.node_id = node.node_id
+            b.available = dict(b.resources)
+        pg.state = "CREATED"
+        self._event("pg_created", pg_id=pg.pg_id.hex())
+        for fut in pg.pending_waiters:
+            if not fut.done():
+                fut.set_result({"state": "CREATED", "bundle_nodes": [b.node_id for b in pg.bundles]})
+        pg.pending_waiters.clear()
+        # Leases queued with PLACEMENT_GROUP strategy were unschedulable until
+        # now — wake them.
+        await self._retry_pending()
+
+    def _plan_bundles(self, pg: PGRecord) -> Optional[list]:
+        nodes = [n for n in self.nodes.values() if n.state == "ALIVE"]
+        nodes.sort(key=lambda n: n.node_id)
+        avail = {n.node_id: dict(n.resources_available) for n in nodes}
+        byid = {n.node_id: n for n in nodes}
+        assignment: list = []
+        strategy = pg.strategy
+        if strategy == "STRICT_PACK":
+            for n in nodes:
+                a = dict(avail[n.node_id])
+                if all(_fits_consume(a, b.resources) for b in pg.bundles):
+                    return [n] * len(pg.bundles)
+            return None
+        used_nodes: list[str] = []
+        for b in pg.bundles:
+            candidates = [n for n in nodes if _fits(avail[n.node_id], b.resources)]
+            if strategy == "STRICT_SPREAD":
+                candidates = [n for n in candidates if n.node_id not in used_nodes]
+            if not candidates:
+                return None
+            if strategy in ("SPREAD", "STRICT_SPREAD"):
+                fresh = [n for n in candidates if n.node_id not in used_nodes]
+                pick = (fresh or candidates)[0]
+            else:  # PACK
+                packed = [n for n in candidates if n.node_id in used_nodes]
+                pick = (packed or candidates)[0]
+            _sub(avail[pick.node_id], b.resources)
+            used_nodes.append(pick.node_id)
+            assignment.append(byid[pick.node_id])
+        return assignment
+
+    async def _pg_retry_loop(self, pg: PGRecord):
+        while pg.state == "PENDING" and pg.pg_id in self.pgs:
+            await asyncio.sleep(0.2)
+            if pg.state == "PENDING":
+                assignment = self._plan_bundles(pg)
+                if assignment is not None:
+                    await self._schedule_pg(pg)
+                    return
+
+    async def handle_remove_placement_group(self, conn, p):
+        pg = self.pgs.get(p["pg_id"])
+        if pg is None:
+            return False
+        await self._remove_pg(pg)
+        return True
+
+    async def _remove_pg(self, pg: PGRecord):
+        if pg.state == "CREATED":
+            for b in pg.bundles:
+                node = self.nodes.get(b.node_id)
+                if node and node.state == "ALIVE":
+                    _add(node.resources_available, b.resources)
+        pg.state = "REMOVED"
+        self.pgs.pop(pg.pg_id, None)
+        self._event("pg_removed", pg_id=pg.pg_id.hex())
+        await self._retry_pending()
+
+    def handle_get_placement_group(self, conn, p):
+        pg = self.pgs.get(p["pg_id"])
+        if pg is None:
+            return None
+        return {
+            "state": pg.state,
+            "strategy": pg.strategy,
+            "bundles": [{"index": b.index, "resources": b.resources, "node_id": b.node_id, "available": b.available} for b in pg.bundles],
+        }
+
+    # -- object directory ----------------------------------------------
+    def handle_report_object(self, conn, p):
+        oid = p["oid"]
+        self.object_dir.setdefault(oid, set()).add(p["node_id"])
+        self.object_sizes[oid] = p.get("size", 0)
+        self.publish("object", oid.hex() if hasattr(oid, "hex") else str(oid), {"node_id": p["node_id"]})
+        return True
+
+    def handle_report_objects_evicted(self, conn, p):
+        for oid in p["oids"]:
+            nodes = self.object_dir.get(oid)
+            if nodes:
+                nodes.discard(p["node_id"])
+                if not nodes:
+                    self.object_dir.pop(oid, None)
+                    self.object_sizes.pop(oid, None)
+        return True
+
+    def handle_lookup_object(self, conn, p):
+        nodes = self.object_dir.get(p["oid"], set())
+        return [
+            {"node_id": nid, "address": self.nodes[nid].address, "store_path": self.nodes[nid].store_path}
+            for nid in nodes
+            if nid in self.nodes and self.nodes[nid].state == "ALIVE"
+        ]
+
+    async def handle_free_objects(self, conn, p):
+        oids = p["oids"]
+        by_node: dict[str, list] = {}
+        for oid in oids:
+            for nid in self.object_dir.pop(oid, set()):
+                by_node.setdefault(nid, []).append(oid)
+            self.object_sizes.pop(oid, None)
+        for nid, node_oids in by_node.items():
+            node = self.nodes.get(nid)
+            if node and node.state == "ALIVE" and node.conn:
+                try:
+                    await node.conn.call("delete_objects", {"oids": node_oids}, timeout=5)
+                except Exception:
+                    pass
+        return True
+
+
+def _fits_consume(avail: dict, demand: dict) -> bool:
+    if not _fits(avail, demand):
+        return False
+    _sub(avail, demand)
+    return True

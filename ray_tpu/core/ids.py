@@ -1,0 +1,123 @@
+"""Binary unique IDs for jobs, tasks, actors, objects, nodes and placement groups.
+
+Mirrors the capability surface of the reference's ID types
+(/root/reference/src/ray/common/id.h) with a simpler layout: every ID is a
+fixed-size random byte string with a cheap hex representation. Object IDs
+embed their owner's job for debuggability but are otherwise opaque.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_KIND_SIZES = {
+    "JobID": 4,
+    "NodeID": 16,
+    "WorkerID": 16,
+    "ActorID": 12,
+    "TaskID": 16,
+    "ObjectID": 16,
+    "PlacementGroupID": 12,
+}
+
+
+class BaseID:
+    SIZE = 16
+    __slots__ = ("_bytes",)
+
+    def __init__(self, raw: bytes):
+        if len(raw) != self.SIZE:
+            raise ValueError(f"{type(self).__name__} needs {self.SIZE} bytes, got {len(raw)}")
+        self._bytes = raw
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, h: str):
+        return cls(bytes.fromhex(h))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()[:12]}…)" if self.SIZE > 8 else f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+    _counter = 0
+    _lock = threading.Lock()
+
+    @classmethod
+    def from_int(cls, i: int):
+        return cls(i.to_bytes(4, "little"))
+
+    def int(self) -> int:
+        return int.from_bytes(self._bytes, "little")
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+class ActorID(BaseID):
+    SIZE = 12
+
+
+class TaskID(BaseID):
+    SIZE = 16
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 12
+
+
+class ObjectID(BaseID):
+    """Object ID = task id (16B) + return index (4B little endian)."""
+
+    SIZE = 20
+
+    @classmethod
+    def for_return(cls, task_id: TaskID, index: int):
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def from_put(cls):
+        return cls(os.urandom(16) + (2**32 - 1).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:16])
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._bytes[16:], "little")
+
+    def is_put(self) -> bool:
+        return self.return_index() == 2**32 - 1

@@ -1,0 +1,85 @@
+"""ObjectRef: a first-class future naming a value owned by some worker.
+
+Mirrors the reference's ObjectRef + ownership model
+(/root/reference/src/ray/core_worker/reference_counter.h:44 — the owner is the
+worker that created the value; borrowers resolve and refcount through it).
+The ref carries its owner's RPC address so any holder can resolve it without a
+central directory lookup (the directory is a fallback, as in the reference's
+OwnershipObjectDirectory).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ray_tpu.core.ids import ObjectID
+
+# Set by the core worker at init; used by ObjectRef.__del__ / get.
+_ref_removed_hook: Optional[Callable] = None
+_ref_created_hook: Optional[Callable] = None
+
+
+def set_ref_hooks(created: Callable | None, removed: Callable | None):
+    global _ref_created_hook, _ref_removed_hook
+    _ref_created_hook = created
+    _ref_removed_hook = removed
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_addr", "size_hint", "_registered", "__weakref__")
+
+    def __init__(self, oid: ObjectID, owner_addr: str, size_hint: int = 0, _register: bool = True):
+        self.id = oid
+        self.owner_addr = owner_addr
+        self.size_hint = size_hint
+        self._registered = False
+        if _register and _ref_created_hook is not None:
+            _ref_created_hook(self)
+            self._registered = True
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def __reduce__(self):
+        return (_reconstruct_ref, (self.id, self.owner_addr, self.size_hint))
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:16]}, owner={self.owner_addr})"
+
+    def __del__(self):
+        if self._registered and _ref_removed_hook is not None:
+            try:
+                _ref_removed_hook(self)
+            except Exception:
+                pass
+
+    # Allow ``await ref`` inside async actors / driver coroutines.
+    def __await__(self):
+        from ray_tpu.core import api
+
+        return api.get_async(self).__await__()
+
+    def future(self):
+        from ray_tpu.core import api
+
+        return api.get_async(self)
+
+
+def _reconstruct_ref(oid: ObjectID, owner_addr: str, size_hint: int) -> ObjectRef:
+    return ObjectRef(oid, owner_addr, size_hint)
+
+
+class ObjectLostError(Exception):
+    pass
+
+
+class GetTimeoutError(TimeoutError):
+    pass

@@ -1,0 +1,202 @@
+"""Python client for the node-local shared-memory object store.
+
+Equivalent to the reference's plasma client + CoreWorkerMemoryStore pairing
+(/root/reference/src/ray/core_worker/store_provider/): small objects live in an
+in-process dict (``MemoryStore``); large objects live in the node's mmap'd C++
+arena (``SharedMemoryClient`` over native/shm_store.cpp) and are read
+zero-copy as memoryviews.
+"""
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import threading
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.native.build import build_lib
+
+_ID_SIZE = 20
+
+
+class _Lib:
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls):
+        with cls._lock:
+            if cls._instance is None:
+                lib = ctypes.CDLL(build_lib("shm_store"))
+                lib.store_create.restype = ctypes.c_void_p
+                lib.store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+                lib.store_attach.restype = ctypes.c_void_p
+                lib.store_attach.argtypes = [ctypes.c_char_p]
+                lib.store_detach.argtypes = [ctypes.c_void_p]
+                lib.store_create_obj.restype = ctypes.c_int64
+                lib.store_create_obj.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+                lib.store_seal.restype = ctypes.c_int
+                lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.store_get.restype = ctypes.c_int64
+                lib.store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+                lib.store_release.restype = ctypes.c_int
+                lib.store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.store_contains.restype = ctypes.c_int
+                lib.store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.store_delete.restype = ctypes.c_int
+                lib.store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.store_evict.restype = ctypes.c_int
+                lib.store_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32]
+                for fn in ("store_capacity", "store_used", "store_num_objects"):
+                    getattr(lib, fn).restype = ctypes.c_uint64
+                    getattr(lib, fn).argtypes = [ctypes.c_void_p]
+                cls._instance = lib
+            return cls._instance
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class ObjectExistsError(Exception):
+    pass
+
+
+class SharedMemoryClient:
+    """Attach to (or create) a node's shm arena and do zero-copy object IO."""
+
+    def __init__(self, path: str, capacity: int | None = None, create: bool = False):
+        self.path = path
+        self._lib = _Lib.get()
+        if create:
+            if capacity is None:
+                raise ValueError("capacity required to create a store")
+            self._h = self._lib.store_create(path.encode(), capacity)
+        else:
+            self._h = self._lib.store_attach(path.encode())
+        if not self._h:
+            raise OSError(f"cannot {'create' if create else 'attach'} shm store at {path}")
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._mmap = mmap.mmap(fd, 0)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mmap)
+        self._lock = threading.Lock()
+
+    # -- write path -----------------------------------------------------
+    def create(self, oid: ObjectID, size: int) -> memoryview:
+        """Allocate and return a writable view; call seal() when done."""
+        with self._lock:
+            off = self._lib.store_create_obj(self._h, oid.binary(), size)
+        if off == -1:
+            raise ObjectExistsError(oid.hex())
+        if off in (-2, -3):
+            raise ObjectStoreFullError(f"{size} bytes (used={self.used}/{self.capacity})")
+        return self._view[off : off + size]
+
+    def seal(self, oid: ObjectID):
+        if self._lib.store_seal(self._h, oid.binary()) != 0:
+            raise KeyError(f"seal: {oid.hex()} not in created state")
+
+    def create_autoevict(self, oid: ObjectID, size: int) -> tuple[memoryview, list[ObjectID]]:
+        """create(), evicting LRU objects if needed. Returns (buffer, evicted
+        ids) — the caller must report evictions to the object directory."""
+        try:
+            return self.create(oid, size), []
+        except ObjectStoreFullError:
+            evicted = self.evict(size + (size >> 3))
+            return self.create(oid, size), evicted
+
+    def put(self, oid: ObjectID, data: bytes | memoryview) -> list[ObjectID]:
+        buf, evicted = self.create_autoevict(oid, len(data))
+        buf[:] = data
+        self.seal(oid)
+        return evicted
+
+    # -- read path ------------------------------------------------------
+    def get(self, oid: ObjectID) -> Optional[memoryview]:
+        """Pinned zero-copy view, or None. Pair with release()."""
+        size = ctypes.c_uint64()
+        with self._lock:
+            off = self._lib.store_get(self._h, oid.binary(), ctypes.byref(size))
+        if off < 0:
+            return None
+        return self._view[off : off + size.value]
+
+    def release(self, oid: ObjectID):
+        self._lib.store_release(self._h, oid.binary())
+
+    def get_copy(self, oid: ObjectID) -> Optional[bytes]:
+        view = self.get(oid)
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            self.release(oid)
+
+    # -- management -----------------------------------------------------
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(self._lib.store_contains(self._h, oid.binary()))
+
+    def delete(self, oid: ObjectID) -> bool:
+        return self._lib.store_delete(self._h, oid.binary()) == 0
+
+    def evict(self, nbytes: int, max_ids: int = 4096) -> list[ObjectID]:
+        buf = ctypes.create_string_buffer(_ID_SIZE * max_ids)
+        n = self._lib.store_evict(self._h, nbytes, buf, max_ids)
+        return [ObjectID(buf.raw[i * _ID_SIZE : (i + 1) * _ID_SIZE]) for i in range(n)]
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.store_capacity(self._h)
+
+    @property
+    def used(self) -> int:
+        return self._lib.store_used(self._h)
+
+    @property
+    def num_objects(self) -> int:
+        return self._lib.store_num_objects(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.store_detach(self._h)
+            self._h = None
+            try:
+                self._view.release()
+                self._mmap.close()
+            except BufferError:
+                # Zero-copy views handed to callers are still alive; the
+                # mapping stays until they are dropped (process exit cleans up).
+                pass
+
+
+class MemoryStore:
+    """In-process store for small / inlined objects (reference:
+    CoreWorkerMemoryStore, store_provider/memory_store)."""
+
+    def __init__(self):
+        self._data: dict[ObjectID, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, oid: ObjectID, data: bytes):
+        with self._lock:
+            self._data[oid] = data
+
+    def get(self, oid: ObjectID) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(oid)
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._data
+
+    def delete(self, oid: ObjectID):
+        with self._lock:
+            self._data.pop(oid, None)
+
+    def __len__(self):
+        return len(self._data)

@@ -1,0 +1,78 @@
+"""Placement groups: gang resource reservation (reference:
+/root/reference/python/ray/util/placement_group.py + GCS/raylet managers;
+strategies PACK/SPREAD/STRICT_PACK/STRICT_SPREAD per
+bundle_scheduling_policy.h:73-97).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ray_tpu.core.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: list[dict], strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    @property
+    def bundle_specs(self):
+        return self.bundles
+
+    def ready(self, timeout: float | None = None) -> bool:
+        """Block until all bundles are reserved."""
+        from ray_tpu.core import api
+
+        core = api._require_worker()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            info = core._run(core.controller.call("get_placement_group", {"pg_id": self.id}))
+            if info is not None and info["state"] == "CREATED":
+                return True
+            if info is None or info["state"] == "REMOVED":
+                return False
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        return self.ready(timeout=timeout_seconds)
+
+    def bundle_nodes(self) -> list[str]:
+        from ray_tpu.core import api
+
+        core = api._require_worker()
+        info = core._run(core.controller.call("get_placement_group", {"pg_id": self.id}))
+        return [b["node_id"] for b in info["bundles"]] if info else []
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles, self.strategy))
+
+
+def placement_group(bundles: list[dict], strategy: str = "PACK", name: str = "", wait: bool = False) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty resource dicts")
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    pg_id = PlacementGroupID.from_random()
+    core._run(
+        core.controller.call(
+            "create_placement_group",
+            {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name, "job_id": core.job_id, "wait": wait},
+        )
+    )
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    core._run(core.controller.call("remove_placement_group", {"pg_id": pg.id}))

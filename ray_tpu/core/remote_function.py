@@ -1,0 +1,58 @@
+"""@remote function decorator plumbing (reference:
+/root/reference/python/ray/remote_function.py).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import replace
+from typing import Any
+
+from ray_tpu.core.task_spec import TaskOptions
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: TaskOptions | None = None):
+        self._fn = fn
+        self._opts = options or TaskOptions()
+        self._fn_id: str | None = None
+        functools.update_wrapper(self, fn)
+
+    def options(self, **kwargs) -> "RemoteFunction":
+        new = _apply_options(self._opts, kwargs)
+        clone = RemoteFunction(self._fn, new)
+        clone._fn_id = self._fn_id
+        return clone
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core import api
+
+        core = api._require_worker()
+        if self._fn_id is None:
+            self._fn_id = core.export_callable("fn", self._fn)
+        refs = core.submit_task_sync(self._fn_id, args, kwargs, replace(self._opts))
+        return refs[0] if self._opts.num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {getattr(self._fn, '__name__', '?')}() cannot be called directly; use .remote()"
+        )
+
+
+def _apply_options(base: TaskOptions, kwargs: dict) -> TaskOptions:
+    new = replace(base)
+    for k, v in kwargs.items():
+        if k == "placement_group":
+            from ray_tpu.core.placement_group import PlacementGroup
+            from ray_tpu.core.task_spec import SchedulingStrategy
+
+            if isinstance(v, PlacementGroup):
+                new.scheduling_strategy = SchedulingStrategy(
+                    kind="PLACEMENT_GROUP", placement_group=v.id, bundle_index=kwargs.get("placement_group_bundle_index", -1)
+                )
+            continue
+        if k == "placement_group_bundle_index":
+            continue
+        if not hasattr(new, k):
+            raise TypeError(f"unknown option {k!r}")
+        setattr(new, k, v)
+    return new

@@ -1,0 +1,225 @@
+"""Minimal symmetric asyncio RPC: length-prefixed pickled frames over TCP/UDS.
+
+Role-equivalent to the reference's gRPC scaffolding (/root/reference/src/ray/rpc):
+every process exposes a handler object; both ends of a connection can invoke
+methods on the other (the reference achieves the same with per-direction gRPC
+services, e.g. CoreWorkerService.PushTask flowing caller->callee and
+PubsubLongPolling flowing callee->caller). Frames are pickled tuples —
+small control messages only; bulk data rides the shared-memory object store.
+
+Wire format: 8-byte little-endian length, then pickle of
+  (kind, msg_id, method_or_status, payload)
+kind: 0=request, 1=reply, 2=notify (no reply expected).
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import socket
+import time
+import traceback
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+_REQ, _REP, _NOTIFY = 0, 1, 2
+_HDR = 8
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def parse_addr(addr: str):
+    if addr.startswith("unix:"):
+        return ("unix", addr[5:])
+    host, _, port = addr.rpartition(":")
+    return ("tcp", host, int(port))
+
+
+class Connection:
+    """One live peer connection. ``call`` awaits a reply; ``notify`` doesn't."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, handler: Any, peer_name: str = "?"):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.peer_name = peer_name
+        self._loop = asyncio.get_running_loop()
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._send_lock = asyncio.Lock()
+        self._task = asyncio.create_task(self._read_loop())
+        self.on_close = None  # optional callback
+        self.meta: dict = {}  # server-side per-connection state (registration info)
+
+    async def _send(self, frame: tuple):
+        data = pickle.dumps(frame, protocol=5)
+        async with self._send_lock:
+            self.writer.write(len(data).to_bytes(_HDR, "little") + data)
+            await self.writer.drain()
+
+    async def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.peer_name} closed")
+        msg_id = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            await self._send((_REQ, msg_id, method, payload))
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msg_id, None)
+
+    async def notify(self, method: str, payload: Any = None):
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.peer_name} closed")
+        await self._send((_NOTIFY, 0, method, payload))
+
+    async def _read_loop(self):
+        try:
+            while True:
+                hdr = await self.reader.readexactly(_HDR)
+                ln = int.from_bytes(hdr, "little")
+                data = await self.reader.readexactly(ln)
+                kind, msg_id, method, payload = pickle.loads(data)
+                if kind == _REP:
+                    fut = self._pending.get(msg_id)
+                    if fut is not None and not fut.done():
+                        ok, result = method, payload
+                        if ok == "ok":
+                            fut.set_result(result)
+                        else:
+                            fut.set_exception(result if isinstance(result, BaseException) else RpcError(str(result)))
+                else:
+                    asyncio.create_task(self._dispatch(kind, msg_id, method, payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        except asyncio.CancelledError:
+            return
+        except Exception:
+            logger.exception("rpc read loop error (peer=%s)", self.peer_name)
+        finally:
+            self._teardown()
+
+    async def _dispatch(self, kind, msg_id, method, payload):
+        try:
+            fn = getattr(self.handler, "handle_" + method, None)
+            if fn is None:
+                raise RpcError(f"no handler for {method!r} on {type(self.handler).__name__}")
+            result = fn(self, payload)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if kind == _REQ:
+                await self._send((_REP, msg_id, "ok", result))
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            if kind == _REQ:
+                try:
+                    pickle.dumps(e)
+                    err: Any = e
+                except Exception:
+                    err = RpcError(f"{type(e).__name__}: {e}\n{traceback.format_exc()}")
+                try:
+                    await self._send((_REP, msg_id, "err", err))
+                except Exception:
+                    pass
+            else:
+                logger.exception("error in notify handler %s", method)
+
+    def _teardown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection to {self.peer_name} lost"))
+                fut.add_done_callback(lambda f: f.exception())
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            cb, self.on_close = self.on_close, None
+            try:
+                cb(self)
+            except Exception:
+                if not self._loop.is_closed():
+                    logger.debug("on_close callback failed", exc_info=True)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    async def close(self):
+        self._task.cancel()
+        self._teardown()
+
+
+class RpcServer:
+    """Listens on tcp host:port (port=0 picks free) and/or a unix path."""
+
+    def __init__(self, handler: Any, host: str = "127.0.0.1"):
+        self.handler = handler
+        self.host = host
+        self.port: int | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self.connections: set[Connection] = set()
+
+    async def start(self, port: int = 0) -> str:
+        self._server = await asyncio.start_server(self._on_client, self.host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.address
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer, self.handler, peer_name="client")
+        self.connections.add(conn)
+        conn.on_close = self.connections.discard
+        cb = getattr(self.handler, "on_connection", None)
+        if cb:
+            cb(conn)
+
+    async def close(self):
+        if self._server:
+            self._server.close()
+        for conn in list(self.connections):
+            await conn.close()
+        if self._server:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=1.0)
+            except Exception:
+                pass
+
+
+async def connect(addr: str, handler: Any = None, timeout: float = 10.0, retry: bool = True) -> Connection:
+    kind_parts = parse_addr(addr)
+    deadline = time.monotonic() + timeout
+    last_err: Exception | None = None
+    while True:
+        try:
+            if kind_parts[0] == "unix":
+                reader, writer = await asyncio.open_unix_connection(kind_parts[1])
+            else:
+                reader, writer = await asyncio.open_connection(kind_parts[1], kind_parts[2])
+            sock = writer.get_extra_info("socket")
+            if sock is not None and sock.family in (socket.AF_INET, socket.AF_INET6):
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return Connection(reader, writer, handler, peer_name=addr)
+        except (ConnectionRefusedError, FileNotFoundError, OSError) as e:
+            last_err = e
+            if not retry or time.monotonic() > deadline:
+                raise ConnectionLost(f"cannot connect to {addr}: {e}") from e
+            await asyncio.sleep(0.05)

@@ -1,0 +1,121 @@
+"""Serialization surface: cloudpickle-based, protocol 5, ObjectRef-aware.
+
+Equivalent in role to the reference's serialization layer
+(/root/reference/python/ray/_private/serialization.py and
+python/ray/includes/serialization.pxi): values are pickled with out-of-band
+buffer support; ``ObjectRef``s contained inside a value are recorded during
+serialization (for distributed refcounting / dependency resolution) and
+re-registered on deserialization (borrower bookkeeping).
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import traceback
+from typing import Any, Callable
+
+import cloudpickle
+
+_PROTOCOL = 5
+
+
+class SerializationContext:
+    """Process-wide hooks used while (de)serializing ObjectRefs."""
+
+    def __init__(self):
+        self.on_ref_serialized: Callable | None = None
+        self.on_ref_deserialized: Callable | None = None
+
+
+_context = SerializationContext()
+
+
+def get_serialization_context() -> SerializationContext:
+    return _context
+
+
+class _RefAwarePickler(cloudpickle.CloudPickler):
+    def __init__(self, file, protocol=_PROTOCOL, buffer_callback=None):
+        super().__init__(file, protocol=protocol, buffer_callback=buffer_callback)
+        self.contained_refs = []
+
+    def persistent_id(self, obj):
+        # Only used for tracking; refs are still pickled by value via reduce.
+        return None
+
+    def reducer_override(self, obj):
+        from ray_tpu.core.object_ref import ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            self.contained_refs.append(obj)
+            if _context.on_ref_serialized is not None:
+                _context.on_ref_serialized(obj)
+            return obj.__reduce__()
+        return NotImplemented
+
+
+def serialize(value: Any) -> tuple[bytes, list]:
+    """Serialize ``value`` -> (payload bytes, contained ObjectRefs)."""
+    buffers: list[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    p = _RefAwarePickler(f, buffer_callback=buffers.append)
+    p.dump(value)
+    body = f.getvalue()
+    if buffers:
+        parts = [len(buffers).to_bytes(4, "little")]
+        for b in buffers:
+            raw = b.raw()
+            parts.append(len(raw).to_bytes(8, "little"))
+            parts.append(bytes(raw))
+        parts.append(body)
+        return b"B" + b"".join(parts), p.contained_refs
+    return b"P" + body, p.contained_refs
+
+
+def deserialize(data: bytes | memoryview) -> Any:
+    data = memoryview(data)
+    tag = bytes(data[:1])
+    if tag == b"P":
+        return pickle.loads(data[1:])
+    if tag == b"B":
+        off = 1
+        nbuf = int.from_bytes(data[off : off + 4], "little")
+        off += 4
+        buffers = []
+        for _ in range(nbuf):
+            ln = int.from_bytes(data[off : off + 8], "little")
+            off += 8
+            buffers.append(data[off : off + ln])
+            off += ln
+        return pickle.loads(data[off:], buffers=buffers)
+    raise ValueError(f"bad serialization tag {tag!r}")
+
+
+def dumps_function(fn) -> bytes:
+    return cloudpickle.dumps(fn, protocol=_PROTOCOL)
+
+
+def loads_function(data: bytes):
+    return cloudpickle.loads(data)
+
+
+class RemoteError(Exception):
+    """An exception raised inside a remote task/actor, re-raised at the caller.
+
+    Mirrors RayTaskError (/root/reference/python/ray/exceptions.py): carries the
+    remote traceback text and the original exception when picklable.
+    """
+
+    def __init__(self, message: str, cause: BaseException | None = None):
+        super().__init__(message)
+        self.cause = cause
+
+    @classmethod
+    def from_exception(cls, exc: BaseException, where: str = "") -> "RemoteError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        try:
+            cloudpickle.dumps(exc)
+            cause = exc
+        except Exception:
+            cause = None
+        return cls(f"Error in remote {where}:\n{tb}", cause)

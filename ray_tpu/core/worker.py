@@ -1,0 +1,983 @@
+"""CoreWorker: per-process runtime — object ownership, task submission and
+execution, actor runtime, get/put/wait.
+
+Role-equivalent to the reference's CoreWorker
+(/root/reference/src/ray/core_worker/core_worker.h:167) plus its Cython
+binding (_raylet.pyx:2678). The same class runs inside drivers and spawned
+workers (the reference does the same; drivers are CoreWorker processes,
+SURVEY §1). Key flows mirrored:
+
+* task submission with lease caching per scheduling key
+  (normal_task_submitter.h:86) — dependencies are resolved *before* the lease
+  is requested (dependency_resolver.h) so a waiting task never holds
+  resources, which is what makes executor-side blocking deadlock-free;
+* ownership: the creating worker owns its return objects and serves them to
+  borrowers (reference_counter.h:44; borrowers register with the owner);
+* small objects are inlined in replies / the owner's in-process memory store,
+  large objects go to the node's shared-memory arena
+  (store_provider/memory_store, plasma_store_provider.h);
+* actor task queues with per-connection FIFO ordering and
+  max_concurrency via thread pool or asyncio (task_execution/
+  actor_scheduling_queue.h, concurrency groups + fiber.h).
+
+All networking runs on one asyncio loop (a dedicated thread in drivers, the
+main thread in workers); user code runs on executor threads.
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import hashlib
+import inspect
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.core import rpc, serialization
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import GetTimeoutError, ObjectLostError, ObjectRef, set_ref_hooks
+from ray_tpu.core.object_store import MemoryStore, ObjectStoreFullError, SharedMemoryClient
+from ray_tpu.core.serialization import RemoteError
+from ray_tpu.core.task_spec import ActorSpec, TaskOptions, TaskSpec, scheduling_key
+
+logger = logging.getLogger(__name__)
+
+
+class ActorDiedError(Exception):
+    pass
+
+
+class TaskCancelledError(Exception):
+    pass
+
+
+@dataclass
+class OwnedObject:
+    state: str = "PENDING"  # PENDING | READY | FAILED
+    size: int = 0
+    in_memory: bool = False
+    in_shm: bool = False
+    error: Optional[BaseException] = None
+    local_refs: int = 0
+    borrowers: int = 0
+    ready_event: asyncio.Event | None = None
+
+
+@dataclass
+class LeasedWorker:
+    address: str
+    worker_id: str
+    node_addr: str
+    lease_id: str
+    conn: Any = None
+    busy: bool = False
+    last_used: float = 0.0
+
+
+class _KeySubmitter:
+    """Per-scheduling-key task queue + lease pool (reference: per-SchedulingKey
+    state in NormalTaskSubmitter)."""
+
+    def __init__(self, core: "CoreWorker", key: str, opts: TaskOptions):
+        self.core = core
+        self.key = key
+        self.opts = opts
+        self.queue: list[tuple[TaskSpec, asyncio.Future]] = []
+        self.workers: list[LeasedWorker] = []
+        self.pending_lease_requests = 0
+
+    def pump(self):
+        while self.queue:
+            free = next((w for w in self.workers if not w.busy and not (w.conn and w.conn.closed)), None)
+            if free is None:
+                break
+            spec, fut = self.queue.pop(0)
+            free.busy = True
+            asyncio.create_task(self._dispatch(free, spec, fut))
+        want = len(self.queue)
+        while want > 0 and self.pending_lease_requests < min(want, self.core.config.max_pending_lease_requests_per_key):
+            self.pending_lease_requests += 1
+            asyncio.create_task(self._request_lease())
+            want -= 1
+
+    async def _request_lease(self):
+        try:
+            lease_id = os.urandom(8).hex()
+            reply = await self.core.controller.call(
+                "request_lease",
+                {
+                    "lease_id": lease_id,
+                    "demand": self.opts.resource_demand(),
+                    "strategy": self.opts.scheduling_strategy,
+                    "label_selector": self.opts.label_selector,
+                },
+            )
+            if reply.get("infeasible"):
+                err = RuntimeError(f"infeasible resource demand: {self.opts.resource_demand()} (no node can ever satisfy it)")
+                for spec, fut in self.queue:
+                    self.core._fail_task_returns(spec, err)
+                    if not fut.done():
+                        fut.set_result(False)
+                self.queue.clear()
+                return
+            try:
+                daemon = await self.core._daemon_conn(reply["address"])
+                lease = await daemon.call("lease_worker", {"lease_id": lease_id})
+                w = LeasedWorker(lease["address"], lease["worker_id"], reply["address"], lease_id)
+                w.conn = await self.core._peer_conn(w.address)
+            except Exception:
+                # The controller already consumed resources for this lease;
+                # give them back or the node leaks capacity forever.
+                try:
+                    await self.core.controller.call(
+                        "release_lease", {"lease_id": lease_id, "strategy": self.opts.scheduling_strategy}
+                    )
+                except Exception:
+                    pass
+                raise
+            self.workers.append(w)
+        except Exception as e:
+            logger.warning("lease request failed for %s: %s", self.key[:40], e)
+            await asyncio.sleep(self.core.config.rpc_retry_delay_s)
+        finally:
+            self.pending_lease_requests -= 1
+            self.pump()
+
+    async def _dispatch(self, w: LeasedWorker, spec: TaskSpec, fut: asyncio.Future):
+        try:
+            reply = await w.conn.call("push_task", {"spec": spec})
+            self.core._absorb_task_reply(spec, reply, fut)
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
+            await self._drop_worker(w, failed=True)
+            retries = spec.options.max_retries
+            if retries == -1:
+                retries = self.core.config.max_task_retries_default
+            attempts = getattr(spec, "_attempts", 0)
+            if attempts < retries:
+                spec._attempts = attempts + 1  # type: ignore[attr-defined]
+                logger.warning("task %s lost worker (%s); retry %d", spec.task_id.hex()[:8], e, attempts + 1)
+                self.queue.append((spec, fut))
+            else:
+                self.core._fail_task_returns(spec, RemoteError(f"task {spec.task_id.hex()[:8]} failed after retries: {e}"))
+                if not fut.done():
+                    fut.set_result(False)
+        finally:
+            w.busy = False
+            w.last_used = time.monotonic()
+            self.pump()
+
+    async def _drop_worker(self, w: LeasedWorker, failed: bool = False):
+        if w in self.workers:
+            self.workers.remove(w)
+        try:
+            daemon = await self.core._daemon_conn(w.node_addr)
+            await daemon.call("return_worker", {"worker_id": w.worker_id, "reusable": not failed})
+        except Exception:
+            pass
+        try:
+            await self.core.controller.call("release_lease", {"lease_id": w.lease_id, "strategy": self.opts.scheduling_strategy})
+        except Exception:
+            pass
+
+    async def reap_idle(self, linger_s: float):
+        now = time.monotonic()
+        for w in list(self.workers):
+            if not w.busy and now - w.last_used > linger_s and not self.queue:
+                await self._drop_worker(w)
+
+
+class CoreWorker:
+    def __init__(self, mode: str, controller_addr: str, config: Config | None = None):
+        self.mode = mode  # "driver" | "worker"
+        self.controller_addr = controller_addr
+        self.config = config or Config().apply_env()
+        self.worker_id = os.environ.get("RAYTPU_WORKER_ID", WorkerID.from_random().hex())
+        self.node_id = os.environ.get("RAYTPU_NODE_ID", "")
+        self.job_id = JobID.nil()
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread: threading.Thread | None = None
+        self.server = rpc.RpcServer(self)
+        self.address = ""
+        self.controller: rpc.Connection | None = None
+        self.daemon: rpc.Connection | None = None
+        self.daemon_addr = os.environ.get("RAYTPU_DAEMON_ADDR", "")
+        self.store: SharedMemoryClient | None = None
+        self.memory_store = MemoryStore()
+        self.owned: dict[ObjectID, OwnedObject] = {}
+        self._peer_conns: dict[str, rpc.Connection] = {}
+        self._daemon_conns: dict[str, rpc.Connection] = {}
+        self._submitters: dict[str, _KeySubmitter] = {}
+        self._exported: set[str] = set()
+        self._fn_cache: dict[str, Any] = {}
+        self._actor_runtime: Optional["ActorRuntime"] = None
+        self._actor_conns: dict[ActorID, dict] = {}  # actor_id -> {addr, conn, info}
+        self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=1, thread_name_prefix="raytpu-exec")
+        self._shutdown = False
+        # Submitted-task dependency pins: holding the ObjectRef objects keeps
+        # their refcount registrations alive until the task completes
+        # (reference: ReferenceCounter "submitted task references",
+        # reference_counter.h:44).
+        self._inflight_deps: dict[bytes, list] = {}
+        self._bg: list[asyncio.Task] = []
+        self.task_events: list[dict] = []  # per-task event buffer (task_event_buffer.h equiv)
+        self._current_task: Optional[TaskSpec] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start_driver_sync(self):
+        """Spin up the IO loop thread and connect (driver mode)."""
+        ready = threading.Event()
+
+        def run():
+            self.loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self.loop)
+            self.loop.create_task(self._async_init(ready))
+            self.loop.run_forever()
+
+        self._loop_thread = threading.Thread(target=run, name="raytpu-io", daemon=True)
+        self._loop_thread.start()
+        if not ready.wait(self.config.rpc_connect_timeout_s + 5):
+            raise TimeoutError("driver failed to connect to controller")
+
+    async def _async_init(self, ready: threading.Event | None = None):
+        self.address = await self.server.start()
+        self.controller = await rpc.connect(self.controller_addr, handler=self, timeout=self.config.rpc_connect_timeout_s)
+        if self.mode == "driver":
+            reply = await self.controller.call("register_job", {"driver_addr": self.address})
+            self.job_id = JobID(reply["job_id"])
+            self.config = Config.from_dict(reply["config"])
+            nodes = reply["nodes"]
+            # Attach to a local daemon's store if one exists on this host.
+            for nid, info in nodes.items():
+                if info["state"] == "ALIVE" and info["store_path"] and os.path.exists(info["store_path"]):
+                    self.daemon_addr = info["address"]
+                    self.node_id = nid
+                    break
+        if self.daemon_addr:
+            self.daemon = await rpc.connect(self.daemon_addr, handler=self, timeout=self.config.rpc_connect_timeout_s)
+        store_path = os.environ.get("RAYTPU_STORE_PATH", "")
+        if not store_path and self.daemon is not None:
+            node_info = await self.controller.call("get_cluster_state", {})
+            info = node_info["nodes"].get(self.node_id)
+            store_path = info["store_path"] if info else ""
+        if store_path and os.path.exists(store_path):
+            self.store = SharedMemoryClient(store_path)
+        if self.mode == "worker":
+            reply = await self.daemon.call("register_worker", {"worker_id": self.worker_id, "address": self.address})
+            self.node_id = reply["node_id"]
+            self.config = Config.from_dict(reply["config"])
+        set_ref_hooks(self._on_ref_created, self._on_ref_removed)
+        self._bg.append(asyncio.create_task(self._reaper_loop()))
+        if ready is not None:
+            ready.set()
+
+    def attach_loop(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop
+
+    async def _reaper_loop(self):
+        while not self._shutdown:
+            await asyncio.sleep(0.5)
+            for sub in list(self._submitters.values()):
+                await sub.reap_idle(linger_s=2.0)
+
+    def shutdown_sync(self):
+        if self._shutdown or self.loop is None:
+            return
+        self._shutdown = True
+        set_ref_hooks(None, None)
+
+        async def _stop():
+            for sub in self._submitters.values():
+                for w in list(sub.workers):
+                    await sub._drop_worker(w)
+            await self.server.close()
+            for c in list(self._peer_conns.values()) + list(self._daemon_conns.values()):
+                await c.close()
+            if self.controller:
+                await self.controller.close()
+            if self.daemon:
+                await self.daemon.close()
+            for t in asyncio.all_tasks():
+                if t is not asyncio.current_task():
+                    t.cancel()
+            self.loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_stop(), self.loop).result(timeout=5)
+        except Exception:
+            pass
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=2)
+        self._executor.shutdown(wait=False)
+
+    # -- helpers --------------------------------------------------------
+    def _run(self, coro, timeout=None):
+        """Run a coroutine on the IO loop from a sync context."""
+        if self.loop is None:
+            raise RuntimeError("core worker not started")
+        if threading.current_thread() is self._loop_thread or (
+            self._loop_thread is None and threading.current_thread() is threading.main_thread() and self.mode == "worker"
+        ):
+            raise RuntimeError("cannot block the IO loop thread with a sync call")
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            return fut.result(timeout)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            raise GetTimeoutError(f"timed out after {timeout}s")
+
+    async def _peer_conn(self, addr: str) -> rpc.Connection:
+        conn = self._peer_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(addr, handler=self, timeout=self.config.rpc_connect_timeout_s, retry=False)
+            self._peer_conns[addr] = conn
+        return conn
+
+    async def _daemon_conn(self, addr: str) -> rpc.Connection:
+        if addr == self.daemon_addr and self.daemon is not None and not self.daemon.closed:
+            return self.daemon
+        conn = self._daemon_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(addr, handler=self, timeout=self.config.rpc_connect_timeout_s, retry=False)
+            self._daemon_conns[addr] = conn
+        return conn
+
+    def _event(self, kind: str, **kw):
+        self.task_events.append({"ts": time.time(), "kind": kind, **kw})
+        if len(self.task_events) > self.config.event_buffer_size:
+            del self.task_events[: len(self.task_events) // 2]
+
+    # -- ownership / refcounting ---------------------------------------
+    def _on_ref_created(self, ref: ObjectRef):
+        if self._shutdown or self.loop is None:
+            return
+        if ref.owner_addr == self.address:
+            rec = self.owned.get(ref.id)
+            if rec is not None:
+                rec.local_refs += 1
+        else:
+            try:
+                self.loop.call_soon_threadsafe(self._notify_owner, ref.owner_addr, "add_borrow", ref.id.binary())
+            except RuntimeError:
+                pass
+
+    def _on_ref_removed(self, ref: ObjectRef):
+        if self._shutdown or self.loop is None:
+            return
+        try:
+            if ref.owner_addr == self.address:
+                self.loop.call_soon_threadsafe(self._dec_local_ref, ref.id)
+            else:
+                self.loop.call_soon_threadsafe(self._notify_owner, ref.owner_addr, "remove_borrow", ref.id.binary())
+        except RuntimeError:
+            pass
+
+    def _notify_owner(self, owner_addr: str, method: str, oid_bin: bytes):
+        async def go():
+            try:
+                conn = await self._peer_conn(owner_addr)
+                await conn.notify(method, {"oid": oid_bin})
+            except Exception:
+                pass
+
+        asyncio.create_task(go())
+
+    def _dec_local_ref(self, oid: ObjectID):
+        rec = self.owned.get(oid)
+        if rec is None:
+            return
+        rec.local_refs -= 1
+        self._maybe_free(oid, rec)
+
+    def handle_add_borrow(self, conn, p):
+        rec = self.owned.get(ObjectID(p["oid"]))
+        if rec is not None:
+            rec.borrowers += 1
+        return True
+
+    def handle_remove_borrow(self, conn, p):
+        oid = ObjectID(p["oid"])
+        rec = self.owned.get(oid)
+        if rec is not None:
+            rec.borrowers -= 1
+            self._maybe_free(oid, rec)
+        return True
+
+    def _maybe_free(self, oid: ObjectID, rec: OwnedObject):
+        if rec.local_refs <= 0 and rec.borrowers <= 0 and rec.state != "PENDING":
+            self.owned.pop(oid, None)
+            self.memory_store.delete(oid)
+            if rec.in_shm:
+                asyncio.create_task(self._free_remote(oid))
+
+    async def _free_remote(self, oid: ObjectID):
+        try:
+            await self.controller.call("free_objects", {"oids": [oid.binary()]})
+        except Exception:
+            pass
+
+    def _register_owned(self, oid: ObjectID, state="PENDING", **kw) -> OwnedObject:
+        rec = self.owned.get(oid)
+        if rec is None:
+            rec = OwnedObject(state=state, ready_event=asyncio.Event(), **kw)
+            self.owned[oid] = rec
+        return rec
+
+    def _fail_task_returns(self, spec: TaskSpec, err: BaseException):
+        self._inflight_deps.pop(spec.task_id.binary(), None)
+        for i in range(spec.num_returns):
+            self._mark_ready(ObjectID.for_return(spec.task_id, i), size=0, in_memory=False, in_shm=False, error=err)
+
+    def _mark_ready(self, oid: ObjectID, *, size: int, in_memory: bool, in_shm: bool, error: BaseException | None = None):
+        rec = self._register_owned(oid)
+        rec.state = "FAILED" if error is not None else "READY"
+        rec.size = size
+        rec.in_memory = in_memory
+        rec.in_shm = in_shm
+        rec.error = error
+        if rec.ready_event:
+            rec.ready_event.set()
+        self._maybe_free(oid, rec)
+
+    # -- put / get / wait ----------------------------------------------
+    def put_sync(self, value: Any) -> ObjectRef:
+        return self._run(self.put_async(value))
+
+    async def put_async(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_put()
+        data, _refs = serialization.serialize(value)
+        rec = self._register_owned(oid)
+        # Pre-pin before marking ready, else _maybe_free could reap the object
+        # in the window before the returned ObjectRef registers itself.
+        rec.local_refs = 1
+        if self.store is not None and len(data) > self.config.max_inline_object_size:
+            await self._write_shm(oid, data)
+            self._mark_ready(oid, size=len(data), in_memory=False, in_shm=True)
+        else:
+            self.memory_store.put(oid, data)
+            self._mark_ready(oid, size=len(data), in_memory=True, in_shm=False)
+        ref = ObjectRef(oid, self.address, len(data), _register=False)
+        ref._registered = True
+        return ref
+
+    async def _write_shm(self, oid: ObjectID, data: bytes):
+        buf, evicted = self.store.create_autoevict(oid, len(data))
+        buf[:] = data
+        del buf
+        self.store.seal(oid)
+        if evicted:
+            await self._report_evicted(evicted)
+        if self.daemon is not None:
+            await self.daemon.notify("report_sealed", {"oid": oid.binary(), "size": len(data)})
+        else:
+            await self.controller.notify("report_object", {"oid": oid.binary(), "node_id": self.node_id, "size": len(data)})
+
+    async def _report_evicted(self, evicted: list[ObjectID]):
+        try:
+            await self.controller.notify(
+                "report_objects_evicted", {"oids": [o.binary() for o in evicted], "node_id": self.node_id}
+            )
+        except Exception:
+            pass
+
+    def get_sync(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = self._run(self._get_many(list(refs)), timeout=timeout)
+        return out[0] if single else out
+
+    async def get_async(self, ref: ObjectRef):
+        return (await self._get_many([ref]))[0]
+
+    async def _get_many(self, refs: list[ObjectRef]):
+        return await asyncio.gather(*(self._get_one(r) for r in refs))
+
+    async def _get_one(self, ref: ObjectRef, _depth: int = 0):
+        oid = ref.id
+        # 1. in-process memory store
+        data = self.memory_store.get(oid)
+        if data is not None:
+            return self._deserialize_value(data)
+        # 2. owned & pending -> wait for completion
+        rec = self.owned.get(oid)
+        if rec is not None and ref.owner_addr == self.address:
+            if rec.state == "PENDING":
+                await rec.ready_event.wait()
+                rec = self.owned.get(oid) or rec
+            if rec.state == "FAILED":
+                err = rec.error if rec.error is not None else RemoteError("task failed")
+                if isinstance(err, RemoteError) and err.cause is not None:
+                    raise err.cause
+                raise err
+            data = self.memory_store.get(oid)
+            if data is not None:
+                return self._deserialize_value(data)
+        # 3. local shared memory
+        data = self._read_shm(oid)
+        if data is not None:
+            return self._deserialize_value(data)
+        # 4. borrowed -> ask the owner
+        if ref.owner_addr and ref.owner_addr != self.address:
+            try:
+                conn = await self._peer_conn(ref.owner_addr)
+                reply = await conn.call("get_owned", {"oid": oid.binary()})
+            except (rpc.ConnectionLost, rpc.RpcError):
+                reply = None
+            if reply is not None:
+                if "error" in reply:
+                    raise reply["error"]
+                if "inline" in reply:
+                    return self._deserialize_value(reply["inline"])
+                if reply.get("in_shm") and await self._pull_to_local(oid):
+                    data = self._read_shm(oid)
+                    if data is not None:
+                        return self._deserialize_value(data)
+        # 5. directory fallback
+        if self.store is not None and await self._pull_to_local(oid):
+            data = self._read_shm(oid)
+            if data is not None:
+                return self._deserialize_value(data)
+        raise ObjectLostError(f"object {oid.hex()} is unavailable (owner {ref.owner_addr} unreachable or value lost)")
+
+    def _read_shm(self, oid: ObjectID) -> bytes | None:
+        """Read an object payload out of the shared-memory arena.
+
+        Copies while pinned: handing out views backed by unpinned arena pages
+        would let LRU eviction overwrite live user data. True zero-copy reads
+        need a buffer type whose destructor drops the pin (plasma's Buffer
+        object); planned as a small CPython C extension.
+        """
+        if self.store is None:
+            return None
+        view = self.store.get(oid)
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            view.release()
+            self.store.release(oid)
+
+    async def _pull_to_local(self, oid: ObjectID) -> bool:
+        if self.daemon is None:
+            return False
+        try:
+            reply = await self.daemon.call("pull_object", {"oid": oid.binary()})
+            return bool(reply.get("ok"))
+        except Exception:
+            return False
+
+    def _deserialize_value(self, data):
+        value = serialization.deserialize(data)
+        if isinstance(value, RemoteError):
+            raise value.cause if value.cause is not None else value
+        return value
+
+    async def handle_get_owned(self, conn, p):
+        """Serve an owned object to a borrower (ownership protocol; the
+        reference resolves via OwnershipObjectDirectory + plasma promotion)."""
+        oid = ObjectID(p["oid"])
+        rec = self.owned.get(oid)
+        if rec is None:
+            data = self.memory_store.get(oid)
+            if data is not None:
+                return {"inline": bytes(data)}
+            return None
+        if rec.state == "PENDING":
+            await rec.ready_event.wait()
+            rec = self.owned.get(oid) or rec
+        if rec.state == "FAILED":
+            return {"error": rec.error}
+        data = self.memory_store.get(oid)
+        if data is not None:
+            return {"inline": bytes(data)}
+        return {"in_shm": True}
+
+    async def handle_wait_owned(self, conn, p):
+        oid = ObjectID(p["oid"])
+        rec = self.owned.get(oid)
+        if rec is None:
+            return self.memory_store.contains(oid) or (self.store is not None and self.store.contains(oid))
+        if rec.state == "PENDING":
+            try:
+                await asyncio.wait_for(rec.ready_event.wait(), timeout=p.get("timeout", 30.0))
+            except asyncio.TimeoutError:
+                return False
+        return True
+
+    def wait_sync(self, refs: list[ObjectRef], num_returns: int, timeout: float | None):
+        return self._run(self.wait_async(refs, num_returns, timeout))
+
+    async def wait_async(self, refs: list[ObjectRef], num_returns: int, timeout: float | None):
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        pending = {id(r): r for r in refs}
+        ready: list[ObjectRef] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def is_ready(r: ObjectRef) -> bool:
+            if self.memory_store.contains(r.id):
+                return True
+            rec = self.owned.get(r.id)
+            if rec is not None and r.owner_addr == self.address:
+                return rec.state != "PENDING"
+            if self.store is not None and self.store.contains(r.id):
+                return True
+            if r.owner_addr and r.owner_addr != self.address:
+                try:
+                    conn = await self._peer_conn(r.owner_addr)
+                    return bool(await conn.call("wait_owned", {"oid": r.id.binary(), "timeout": 0.001}))
+                except Exception:
+                    return False
+            return False
+
+        while True:
+            for key, r in list(pending.items()):
+                if await is_ready(r):
+                    ready.append(r)
+                    del pending[key]
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(0.005)
+        order = {r.id: i for i, r in enumerate(refs)}
+        ready.sort(key=lambda r: order[r.id])
+        ready = ready[:num_returns]
+        ready_ids = {r.id for r in ready}
+        not_ready = [r for r in refs if r.id not in ready_ids]
+        return ready, not_ready
+
+    # -- function/class export -----------------------------------------
+    def export_callable(self, ns: str, obj: Any) -> str:
+        data = serialization.dumps_function(obj)
+        key = hashlib.sha1(data + self.job_id.binary()).hexdigest()
+        full = f"{ns}:{key}"
+        if full not in self._exported:
+            self._run(self.controller.call("kv_put", {"ns": "exports", "key": full, "value": data, "overwrite": False}))
+            self._exported.add(full)
+        return full
+
+    async def _load_callable(self, key: str):
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+        data = await self.controller.call("kv_get", {"ns": "exports", "key": key})
+        if data is None:
+            raise RuntimeError(f"exported callable {key} not found")
+        obj = serialization.loads_function(data)
+        self._fn_cache[key] = obj
+        return obj
+
+    # -- task submission ------------------------------------------------
+    def submit_task_sync(self, fn_id: str, args: tuple, kwargs: dict, opts: TaskOptions) -> list[ObjectRef]:
+        task_id = TaskID.from_random()
+        return_refs = [ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(opts.num_returns)]
+        args_blob, dep_refs = serialization.serialize((args, kwargs))
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            fn_id=fn_id,
+            args_blob=args_blob,
+            num_returns=opts.num_returns,
+            options=opts,
+            caller_addr=self.address,
+        )
+        # Ownership records must exist before the task can complete, else a
+        # fast reply could free the returns before the refs pin them.
+        self._run(self._register_returns(return_refs))
+        for r in return_refs:
+            r._registered = True
+        self._run(self._submit(spec, dep_refs))
+        return return_refs
+
+    async def _register_returns(self, refs):
+        for r in refs:
+            rec = self._register_owned(r.id)
+            rec.local_refs += 1
+
+    async def _submit(self, spec: TaskSpec, dep_refs: list[ObjectRef]):
+        if dep_refs:
+            self._inflight_deps[spec.task_id.binary()] = dep_refs
+        # Resolve dependencies BEFORE leasing (dependency_resolver.h) so a
+        # queued task never holds a worker while waiting on its args.
+        if dep_refs:
+            await self._wait_deps(dep_refs)
+        key = scheduling_key(spec.fn_id, spec.options)
+        sub = self._submitters.get(key)
+        if sub is None:
+            sub = self._submitters[key] = _KeySubmitter(self, key, spec.options)
+        fut = asyncio.get_running_loop().create_future()
+        fut.add_done_callback(lambda f: f.exception())  # results absorbed via _absorb_task_reply
+        sub.queue.append((spec, fut))
+        self._event("task_submitted", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
+        sub.pump()
+
+    async def _wait_deps(self, dep_refs: list[ObjectRef]):
+        for r in dep_refs:
+            rec = self.owned.get(r.id)
+            if rec is not None and r.owner_addr == self.address:
+                if rec.state == "PENDING":
+                    await rec.ready_event.wait()
+            elif r.owner_addr and r.owner_addr != self.address:
+                try:
+                    conn = await self._peer_conn(r.owner_addr)
+                    await conn.call("wait_owned", {"oid": r.id.binary(), "timeout": 600.0})
+                except Exception:
+                    pass
+
+    def _absorb_task_reply(self, spec: TaskSpec, reply: dict, fut: asyncio.Future):
+        """Record task return values from a push_task reply."""
+        self._inflight_deps.pop(spec.task_id.binary(), None)
+        self._event("task_finished", task_id=spec.task_id.hex(), status=reply.get("status"))
+        if reply.get("status") == "error":
+            err: BaseException = reply.get("error") or RemoteError("task failed")
+            for i in range(spec.num_returns):
+                oid = ObjectID.for_return(spec.task_id, i)
+                self._mark_ready(oid, size=0, in_memory=False, in_shm=False, error=err)
+            if not fut.done():
+                fut.set_result(False)
+            return
+        for i, item in enumerate(reply.get("returns", [])):
+            oid = ObjectID.for_return(spec.task_id, i)
+            if item.get("inline") is not None:
+                self.memory_store.put(oid, item["inline"])
+                self._mark_ready(oid, size=len(item["inline"]), in_memory=True, in_shm=False)
+            else:
+                self._mark_ready(oid, size=item.get("size", 0), in_memory=False, in_shm=True)
+        if not fut.done():
+            fut.set_result(True)
+
+    # -- task execution (executor side) --------------------------------
+    async def handle_push_task(self, conn, p):
+        """Execute a pushed task (reference: CoreWorkerService.PushTask ->
+        TaskReceiver -> scheduling queue -> execute callback)."""
+        spec: TaskSpec = p["spec"]
+        fn = await self._load_callable(spec.fn_id)
+        loop = asyncio.get_running_loop()
+        self._event("task_exec_start", task_id=spec.task_id.hex())
+        try:
+            result = await loop.run_in_executor(self._executor, self._execute_task, fn, spec)
+            returns = await self._package_returns(spec, result)
+            return {"status": "ok", "returns": returns}
+        except BaseException as e:  # noqa: BLE001 - errors propagate to caller
+            return {"status": "error", "error": serialization.RemoteError.from_exception(e, where=f"task {spec.fn_id[:24]}")}
+        finally:
+            self._event("task_exec_end", task_id=spec.task_id.hex())
+
+    def _execute_task(self, fn, spec: TaskSpec):
+        args, kwargs = serialization.deserialize(spec.args_blob)
+        args = [self.get_sync(a) if isinstance(a, ObjectRef) else a for a in args]
+        kwargs = {k: (self.get_sync(v) if isinstance(v, ObjectRef) else v) for k, v in kwargs.items()}
+        self._current_task = spec
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._current_task = None
+
+    async def _package_returns(self, spec: TaskSpec, result) -> list[dict]:
+        values = (result,) if spec.num_returns == 1 else tuple(result) if spec.num_returns > 1 else ()
+        if spec.num_returns > 1 and len(values) != spec.num_returns:
+            raise ValueError(f"task declared num_returns={spec.num_returns} but returned {len(values)}")
+        out = []
+        for i, v in enumerate(values):
+            data, _ = serialization.serialize(v)
+            if len(data) <= self.config.max_inline_object_size or self.store is None:
+                out.append({"inline": data})
+            else:
+                oid = ObjectID.for_return(spec.task_id, i)
+                await self._write_shm(oid, data)
+                out.append({"size": len(data)})
+        return out
+
+    # -- actors: caller side -------------------------------------------
+    def create_actor_sync(self, cls_id: str, init_args_blob: bytes, opts, name: str = "", namespace: str = "default") -> ActorID:
+        actor_id = ActorID.from_random()
+        spec = ActorSpec(
+            actor_id=actor_id,
+            job_id=self.job_id,
+            cls_id=cls_id,
+            init_args_blob=init_args_blob,
+            options=opts,
+            name=name,
+            namespace=namespace,
+            owner_addr=self.address,
+        )
+        info = self._run(self.controller.call("register_actor", {"spec": spec}))
+        if info["state"] == "DEAD":
+            raise ActorDiedError(f"actor failed to start: {info.get('death_cause')}")
+        actor_id = ActorID(info["actor_id"])  # may differ under get_if_exists
+        # Creation is async; worker_addr may still be empty. The first task
+        # push resolves it via wait_actor_alive.
+        self._actor_conns[actor_id] = {"addr": info["worker_addr"], "conn": None, "seq": 0}
+        return actor_id
+
+    def submit_actor_task_sync(self, actor_id: ActorID, method: str, args, kwargs, num_returns: int, opts) -> list[ObjectRef]:
+        task_id = TaskID.from_random()
+        args_blob, dep_refs = serialization.serialize((args, kwargs))
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            fn_id="",
+            args_blob=args_blob,
+            num_returns=num_returns,
+            options=opts,
+            caller_addr=self.address,
+            actor_id=actor_id,
+            method_name=method,
+        )
+        refs = [ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(num_returns)]
+        self._run(self._register_returns(refs))
+        for r in refs:
+            r._registered = True
+        self._run(self._submit_actor_task(spec, dep_refs))
+        return refs
+
+    async def _submit_actor_task(self, spec: TaskSpec, dep_refs):
+        if dep_refs:
+            self._inflight_deps[spec.task_id.binary()] = dep_refs
+            await self._wait_deps(dep_refs)
+        asyncio.create_task(self._push_actor_task(spec))
+
+    async def _push_actor_task(self, spec: TaskSpec, attempt: int = 0):
+        entry = self._actor_conns.get(spec.actor_id)
+        if entry is None:
+            entry = self._actor_conns[spec.actor_id] = {"addr": "", "conn": None, "seq": 0}
+        try:
+            if entry["conn"] is None or entry["conn"].closed:
+                if not entry["addr"]:
+                    await self._refresh_actor_addr(spec.actor_id, entry)
+                entry["conn"] = await self._peer_conn(entry["addr"])
+            spec.seq_no = entry["seq"]
+            entry["seq"] += 1
+            reply = await entry["conn"].call("push_actor_task", {"spec": spec})
+            fut = asyncio.get_running_loop().create_future()
+            fut.add_done_callback(lambda f: f.exception())
+            self._absorb_task_reply(spec, reply, fut)
+        except ActorDiedError as e:
+            self._fail_task_returns(spec, e)
+        except (rpc.ConnectionLost, rpc.RpcError, KeyError) as e:
+            entry["conn"] = None
+            entry["addr"] = ""
+            max_task_retries = getattr(spec.options, "max_task_retries", 0)
+            if attempt < max_task_retries:
+                await asyncio.sleep(self.config.task_retry_delay_s)
+                await self._push_actor_task(spec, attempt + 1)
+            else:
+                self._fail_task_returns(
+                    spec, ActorDiedError(f"actor {spec.actor_id.hex()[:8]} task {spec.method_name} failed: {e}")
+                )
+
+    async def _refresh_actor_addr(self, actor_id: ActorID, entry: dict):
+        info = await self.controller.call("wait_actor_alive", {"actor_id": actor_id.binary()})
+        if info is None or info["state"] == "DEAD":
+            raise ActorDiedError(f"actor {actor_id.hex()[:8]} is dead: {(info or {}).get('death_cause', 'unknown')}")
+        entry["addr"] = info["worker_addr"]
+
+    def kill_actor_sync(self, actor_id: ActorID, no_restart: bool = True):
+        self._run(self.controller.call("kill_actor", {"actor_id": actor_id.binary(), "no_restart": no_restart}))
+
+    # -- actors: executor side -----------------------------------------
+    async def handle_create_actor(self, conn, p):
+        spec: ActorSpec = p["spec"]
+        cls = await self._load_callable(spec.cls_id)
+        args, kwargs = serialization.deserialize(spec.init_args_blob)
+        runtime = ActorRuntime(self, spec, cls)
+        await runtime.construct(args, kwargs)
+        self._actor_runtime = runtime
+        return True
+
+    async def handle_push_actor_task(self, conn, p):
+        if self._actor_runtime is None:
+            raise rpc.RpcError("no actor hosted on this worker")
+        return await self._actor_runtime.execute(p["spec"])
+
+    def handle_shutdown(self, conn, p):
+        self._shutdown = True
+        if self._actor_runtime is not None:
+            self._actor_runtime.on_exit()
+        loop = self.loop
+
+        def stop():
+            loop.stop()
+
+        loop.call_soon(stop)
+        return True
+
+    def handle_health_check(self, conn, p):
+        return {"ok": True, "worker_id": self.worker_id}
+
+
+class ActorRuntime:
+    """Hosts one actor instance: FIFO ordering, max_concurrency via thread
+    pool (sync methods) or asyncio semaphore (async methods)."""
+
+    def __init__(self, core: CoreWorker, spec: ActorSpec, cls):
+        self.core = core
+        self.spec = spec
+        self.cls = cls
+        self.instance = None
+        maxc = max(1, spec.options.max_concurrency)
+        self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=maxc, thread_name_prefix="actor")
+        self.sem = asyncio.Semaphore(maxc)
+        self._ordered = maxc == 1
+        self._chain: asyncio.Future | None = None
+
+    async def construct(self, args, kwargs):
+        loop = asyncio.get_running_loop()
+        args = [self.core.get_sync(a) if isinstance(a, ObjectRef) else a for a in args]
+        kwargs = {k: (self.core.get_sync(v) if isinstance(v, ObjectRef) else v) for k, v in kwargs.items()}
+
+        def make():
+            return self.cls(*args, **kwargs)
+
+        self.instance = await loop.run_in_executor(self.pool, make)
+
+    async def execute(self, spec: TaskSpec) -> dict:
+        method = getattr(self.instance, spec.method_name, None)
+        if method is None:
+            return {
+                "status": "error",
+                "error": RemoteError.from_exception(AttributeError(f"no method {spec.method_name}"), "actor task"),
+            }
+        try:
+            if inspect.iscoroutinefunction(method):
+                async with self.sem:
+                    result = await self._call_async(method, spec)
+            else:
+                loop = asyncio.get_running_loop()
+                coro = loop.run_in_executor(self.pool, self._call_sync, method, spec)
+                if self._ordered:
+                    # Single-threaded pool already serializes; just await.
+                    result = await coro
+                else:
+                    result = await coro
+            returns = await self.core._package_returns(spec, result)
+            return {"status": "ok", "returns": returns}
+        except BaseException as e:  # noqa: BLE001
+            return {"status": "error", "error": RemoteError.from_exception(e, where=f"actor method {spec.method_name}")}
+
+    def _resolve(self, blob):
+        args, kwargs = serialization.deserialize(blob)
+        args = [self.core.get_sync(a) if isinstance(a, ObjectRef) else a for a in args]
+        kwargs = {k: (self.core.get_sync(v) if isinstance(v, ObjectRef) else v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _call_sync(self, method, spec: TaskSpec):
+        args, kwargs = self._resolve(spec.args_blob)
+        return method(*args, **kwargs)
+
+    async def _call_async(self, method, spec: TaskSpec):
+        args, kwargs = await asyncio.get_running_loop().run_in_executor(None, self._resolve, spec.args_blob)
+        return await method(*args, **kwargs)
+
+    def on_exit(self):
+        inst = self.instance
+        if inst is not None and hasattr(inst, "__raytpu_exit__"):
+            try:
+                inst.__raytpu_exit__()
+            except Exception:
+                pass

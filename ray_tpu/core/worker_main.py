@@ -1,0 +1,46 @@
+"""Worker process entrypoint (reference:
+/root/reference/python/ray/_private/workers/default_worker.py).
+
+Spawned by the node daemon with RAYTPU_* env vars; runs the asyncio IO loop on
+the main thread and executes tasks on executor threads. Import stays light —
+jax is only imported if user task code does.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+
+
+def main():
+    logging.basicConfig(level=os.environ.get("RAYTPU_LOG_LEVEL", "WARNING"))
+    from ray_tpu.core.worker import CoreWorker
+
+    controller_addr = os.environ["RAYTPU_CONTROLLER_ADDR"]
+    core = CoreWorker(mode="worker", controller_addr=controller_addr)
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    core.attach_loop(loop)
+
+    async def init():
+        try:
+            await core._async_init()
+        except Exception:
+            logging.exception("worker init failed")
+            loop.stop()
+
+    # Make the global API usable from inside tasks (nested submission).
+    from ray_tpu.core import api
+
+    api._set_global_worker(core)
+
+    loop.create_task(init())
+    try:
+        loop.run_forever()
+    finally:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Test harness config.
+
+JAX tests run on a virtual 8-device CPU mesh (the reference tests multi-host
+TPU scheduling with fake resources the same way — SURVEY §4 "fake TPU
+topology"); real TPU runs are reserved for bench.py.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RAYTPU_OBJECT_STORE_MEMORY", str(64 * 1024 * 1024))
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def shared_ray():
+    import ray_tpu as rt
+
+    rt.init(num_cpus=8)
+    yield rt
+    rt.shutdown()
+
+
+@pytest.fixture
+def fresh_cluster():
+    from ray_tpu.core.api import Cluster
+
+    cluster = Cluster(initialize_head=False)
+    yield cluster
+    cluster.shutdown()

@@ -1,0 +1,256 @@
+"""Core runtime tests: tasks, actors, objects, wait, errors.
+
+Modeled on the reference's python/ray/tests/ suite style: a shared in-process
+cluster fixture (conftest ray_start_shared equivalent) and small, focused
+cases.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+
+
+def test_simple_task(shared_ray):
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    assert rt.get(add.remote(1, 2), timeout=30) == 3
+
+
+def test_task_with_kwargs(shared_ray):
+    @rt.remote
+    def f(a, b=10, c=0):
+        return a + b + c
+
+    assert rt.get(f.remote(1, c=5), timeout=30) == 16
+
+
+def test_chained_dependencies(shared_ray):
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(4):
+        ref = inc.remote(ref)
+    assert rt.get(ref, timeout=30) == 5
+
+
+def test_parallel_tasks(shared_ray):
+    @rt.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(8)]
+    assert rt.get(refs, timeout=30) == [i * i for i in range(8)]
+
+
+def test_num_returns(shared_ray):
+    @rt.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c], timeout=30) == [1, 2, 3]
+
+
+def test_task_exception_propagates(shared_ray):
+    @rt.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError, match="kaboom"):
+        rt.get(boom.remote(), timeout=30)
+
+
+def test_nested_tasks(shared_ray):
+    @rt.remote
+    def inner(x):
+        return x * 2
+
+    @rt.remote
+    def outer(x):
+        return rt.get(inner.remote(x), timeout=30) + 1
+
+    assert rt.get(outer.remote(10), timeout=60) == 21
+
+
+def test_put_get_small(shared_ray):
+    ref = rt.put({"a": [1, 2, 3]})
+    assert rt.get(ref, timeout=10) == {"a": [1, 2, 3]}
+
+
+def test_put_get_large_zero_copy(shared_ray):
+    arr = np.random.rand(500_000)  # 4MB -> shared memory path
+    ref = rt.put(arr)
+    out = rt.get(ref, timeout=10)
+    assert np.array_equal(arr, out)
+
+
+def test_large_arg_to_task(shared_ray):
+    arr = np.ones(300_000)
+
+    @rt.remote
+    def total(a):
+        return float(a.sum())
+
+    assert rt.get(total.remote(rt.put(arr)), timeout=30) == 300_000.0
+
+
+def test_ref_inside_container(shared_ray):
+    inner_ref = rt.put(41)
+
+    @rt.remote
+    def deref(d):
+        return rt.get(d["ref"], timeout=10) + 1
+
+    assert rt.get(deref.remote({"ref": inner_ref}), timeout=30) == 42
+
+
+def test_wait(shared_ray):
+    @rt.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast = slow.remote(0.05)
+    slow_ref = slow.remote(5.0)
+    ready, not_ready = rt.wait([fast, slow_ref], num_returns=1, timeout=10)
+    assert ready == [fast] and not_ready == [slow_ref]
+
+
+def test_wait_timeout(shared_ray):
+    @rt.remote
+    def slow():
+        time.sleep(10)
+
+    ready, not_ready = rt.wait([slow.remote()], num_returns=1, timeout=0.2)
+    assert not ready and len(not_ready) == 1
+
+
+def test_actor_basics(shared_ray):
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def incr(self, by=1):
+            self.v += by
+            return self.v
+
+    c = Counter.remote(5)
+    assert rt.get(c.incr.remote(), timeout=30) == 6
+    assert rt.get(c.incr.remote(4), timeout=10) == 10
+
+
+def test_actor_ordering(shared_ray):
+    @rt.remote
+    class Acc:
+        def __init__(self):
+            self.log = []
+
+        def add(self, i):
+            self.log.append(i)
+            return list(self.log)
+
+    a = Acc.remote()
+    refs = [a.add.remote(i) for i in range(10)]
+    final = rt.get(refs[-1], timeout=30)
+    assert final == list(range(10))
+
+
+def test_async_actor(shared_ray):
+    @rt.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.options(max_concurrency=4).remote()
+    refs = [a.work.remote(i) for i in range(4)]
+    assert rt.get(refs, timeout=30) == [0, 2, 4, 6]
+
+
+def test_named_actor(shared_ray):
+    @rt.remote
+    class Store:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    s = Store.options(name="kvstore").remote()
+    rt.get(s.set.remote("x", 1), timeout=30)
+    h = rt.get_actor("kvstore")
+    assert rt.get(h.get.remote("x"), timeout=10) == 1
+    names = rt.list_named_actors()
+    assert any(n["name"] == "kvstore" for n in names)
+
+
+def test_actor_exception(shared_ray):
+    @rt.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor oops")
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor oops"):
+        rt.get(b.fail.remote(), timeout=30)
+
+
+def test_kill_actor(shared_ray):
+    @rt.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert rt.get(v.ping.remote(), timeout=30) == "pong"
+    rt.kill(v)
+    time.sleep(0.2)
+    with pytest.raises(Exception):
+        rt.get(v.ping.remote(), timeout=5)
+
+
+def test_actor_handle_passing(shared_ray):
+    @rt.remote
+    class Counter2:
+        def __init__(self):
+            self.v = 0
+
+        def incr(self):
+            self.v += 1
+            return self.v
+
+    @rt.remote
+    def bump(handle):
+        return rt.get(handle.incr.remote(), timeout=10)
+
+    c = Counter2.remote()
+    assert rt.get(bump.remote(c), timeout=60) == 1
+    assert rt.get(c.incr.remote(), timeout=10) == 2
+
+
+def test_cluster_resources(shared_ray):
+    total = rt.cluster_resources()
+    assert total.get("CPU", 0) >= 8
+
+
+def test_runtime_context(shared_ray):
+    @rt.remote
+    def whoami():
+        ctx = rt.get_runtime_context()
+        return (ctx.node_id, ctx.worker_id)
+
+    node_id, worker_id = rt.get(whoami.remote(), timeout=30)
+    assert node_id and worker_id

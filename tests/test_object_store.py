@@ -1,0 +1,121 @@
+"""Native shared-memory store unit tests (reference analogue:
+src/ray/object_manager/plasma tests)."""
+import os
+
+import pytest
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import MemoryStore, ObjectExistsError, ObjectStoreFullError, SharedMemoryClient
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = str(tmp_path / "store")
+    s = SharedMemoryClient(path, capacity=4 * 1024 * 1024, create=True)
+    yield s
+    s.close()
+
+
+def test_put_get_roundtrip(store):
+    oid = ObjectID.from_put()
+    data = os.urandom(1000)
+    store.put(oid, data)
+    assert store.contains(oid)
+    assert store.get_copy(oid) == data
+
+
+def test_create_seal_zero_copy(store):
+    oid = ObjectID.from_put()
+    buf = store.create(oid, 8)
+    buf[:] = b"abcdefgh"
+    del buf
+    assert not store.contains(oid)  # not sealed yet
+    store.seal(oid)
+    view = store.get(oid)
+    assert bytes(view) == b"abcdefgh"
+    view.release()
+    store.release(oid)
+
+
+def test_duplicate_create_raises(store):
+    oid = ObjectID.from_put()
+    store.put(oid, b"x")
+    with pytest.raises(ObjectExistsError):
+        store.create(oid, 1)
+
+
+def test_delete(store):
+    oid = ObjectID.from_put()
+    store.put(oid, b"x")
+    assert store.delete(oid)
+    assert not store.contains(oid)
+    assert store.get(oid) is None
+
+
+def test_pinned_object_not_deleted(store):
+    oid = ObjectID.from_put()
+    store.put(oid, b"hello")
+    view = store.get(oid)  # pins
+    assert not store.delete(oid)
+    view.release()
+    store.release(oid)
+    assert store.delete(oid)
+
+
+def test_lru_eviction_under_pressure(store):
+    oids = []
+    for _ in range(8):
+        oid = ObjectID.from_put()
+        store.put(oid, os.urandom(700 * 1024))
+        oids.append(oid)
+    # 8 * 700KB > 4MB: the oldest objects must have been evicted.
+    assert store.num_objects < 8
+    assert store.contains(oids[-1])
+    assert not store.contains(oids[0])
+
+
+def test_pinned_objects_survive_eviction(store):
+    first = ObjectID.from_put()
+    store.put(first, os.urandom(700 * 1024))
+    view = store.get(first)  # pin
+    for _ in range(8):
+        store.put(ObjectID.from_put(), os.urandom(400 * 1024))
+    assert store.contains(first)
+    view.release()
+    store.release(first)
+
+
+def test_oversize_object_rejected(store):
+    with pytest.raises(ObjectStoreFullError):
+        store.put(ObjectID.from_put(), b"x" * (8 * 1024 * 1024))
+
+
+def test_cross_client_visibility(store, tmp_path):
+    other = SharedMemoryClient(str(tmp_path / "store"))
+    oid = ObjectID.from_put()
+    store.put(oid, b"shared")
+    assert other.get_copy(oid) == b"shared"
+    other.close()
+
+
+def test_free_list_reuse(store):
+    # Fill, delete, refill — allocator must reuse space (coalescing).
+    for _ in range(3):
+        oids = []
+        for _ in range(4):
+            oid = ObjectID.from_put()
+            store.put(oid, os.urandom(900 * 1024))
+            oids.append(oid)
+        for oid in oids:
+            store.delete(oid)
+    assert store.used < 100 * 1024
+
+
+def test_memory_store():
+    ms = MemoryStore()
+    oid = ObjectID.from_put()
+    ms.put(oid, b"v")
+    assert ms.contains(oid)
+    assert ms.get(oid) == b"v"
+    ms.delete(oid)
+    assert not ms.contains(oid)
