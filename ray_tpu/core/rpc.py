@@ -66,6 +66,29 @@ class Connection:
             self.writer.write(len(data).to_bytes(_HDR, "little") + data)
             await self.writer.drain()
 
+    def call_start(self, method: str, payload: Any = None) -> "asyncio.Future":
+        """Synchronously enqueue a request frame; return the reply future.
+
+        Unlike ``call``, the frame hits the transport buffer before this
+        returns, so invocation order == wire order — required by per-actor
+        FIFO task submission (the reference orders actor tasks with sequence
+        numbers in ActorTaskSubmitter; here wire order is the sequence).
+        """
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.peer_name} closed")
+        msg_id = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        fut.add_done_callback(lambda f: self._pending.pop(msg_id, None))
+        data = pickle.dumps((_REQ, msg_id, method, payload), protocol=5)
+        self.writer.write(len(data).to_bytes(_HDR, "little") + data)
+        return fut
+
+    async def flush(self):
+        """Await transport drain — backpressure for call_start senders."""
+        async with self._send_lock:
+            await self.writer.drain()
+
     async def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
         if self._closed:
             raise ConnectionLost(f"connection to {self.peer_name} closed")

@@ -71,7 +71,6 @@ class TaskSpec:
     # actor-task fields
     actor_id: Optional[ActorID] = None
     method_name: str = ""
-    seq_no: int = -1
 
     @property
     def is_actor_task(self) -> bool:

@@ -214,6 +214,7 @@ class CoreWorker:
         self._exported: set[str] = set()
         self._fn_cache: dict[str, Any] = {}
         self._actor_runtime: Optional["ActorRuntime"] = None
+        self._actor_send_queues: dict = {}
         self._actor_conns: dict[ActorID, dict] = {}  # actor_id -> {addr, conn, info}
         self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=1, thread_name_prefix="raytpu-exec")
         self._shutdown = False
@@ -729,7 +730,7 @@ class CoreWorker:
                 except Exception:
                     pass
 
-    def _absorb_task_reply(self, spec: TaskSpec, reply: dict, fut: asyncio.Future):
+    def _absorb_task_reply(self, spec: TaskSpec, reply: dict, fut: asyncio.Future | None = None):
         """Record task return values from a push_task reply."""
         self._inflight_deps.pop(spec.task_id.binary(), None)
         self._event("task_finished", task_id=spec.task_id.hex(), status=reply.get("status"))
@@ -738,7 +739,7 @@ class CoreWorker:
             for i in range(spec.num_returns):
                 oid = ObjectID.for_return(spec.task_id, i)
                 self._mark_ready(oid, size=0, in_memory=False, in_shm=False, error=err)
-            if not fut.done():
+            if fut is not None and not fut.done():
                 fut.set_result(False)
             return
         for i, item in enumerate(reply.get("returns", [])):
@@ -812,7 +813,7 @@ class CoreWorker:
         actor_id = ActorID(info["actor_id"])  # may differ under get_if_exists
         # Creation is async; worker_addr may still be empty. The first task
         # push resolves it via wait_actor_alive.
-        self._actor_conns[actor_id] = {"addr": info["worker_addr"], "conn": None, "seq": 0}
+        self._actor_conns[actor_id] = {"addr": info["worker_addr"], "conn": None}
         return actor_id
 
     def submit_actor_task_sync(self, actor_id: ActorID, method: str, args, kwargs, num_returns: int, opts) -> list[ObjectRef]:
@@ -837,26 +838,107 @@ class CoreWorker:
         return refs
 
     async def _submit_actor_task(self, spec: TaskSpec, dep_refs):
-        if dep_refs:
-            self._inflight_deps[spec.task_id.binary()] = dep_refs
-            await self._wait_deps(dep_refs)
-        asyncio.create_task(self._push_actor_task(spec))
+        # Per-actor FIFO pump: submission order must equal wire order (actor
+        # tasks execute in arrival order on the executor). A create_task per
+        # spec would let conn-setup/dep awaits interleave and reorder sends.
+        q = self._actor_send_queues.get(spec.actor_id)
+        if q is None:
+            q = self._actor_send_queues[spec.actor_id] = asyncio.Queue()
+            asyncio.create_task(self._actor_send_pump(spec.actor_id, q))
+        q.put_nowait((spec, dep_refs))
 
-    async def _push_actor_task(self, spec: TaskSpec, attempt: int = 0):
+    async def _actor_send_pump(self, actor_id: ActorID, q: "asyncio.Queue"):
+        while True:
+            spec, dep_refs = await q.get()
+            try:
+                if dep_refs:
+                    self._inflight_deps[spec.task_id.binary()] = dep_refs
+                    await self._wait_deps(dep_refs)
+                await self._push_actor_task_ordered(spec)
+            except ActorDiedError as e:
+                self._fail_task_returns(spec, e)
+                # Actor is gone: fail everything still queued and retire the
+                # pump (a later submission spawns a fresh one, which handles
+                # the restarted-actor case via address refresh).
+                while not q.empty():
+                    pending_spec, _ = q.get_nowait()
+                    self._fail_task_returns(pending_spec, e)
+                if self._actor_send_queues.get(actor_id) is q:
+                    del self._actor_send_queues[actor_id]
+                return
+            except Exception as e:  # keep the pump alive for later tasks
+                self._fail_task_returns(
+                    spec,
+                    ActorDiedError(
+                        f"actor {actor_id.hex()[:8]} task {spec.method_name} failed to submit: {e}"
+                    ),
+                )
+
+    async def _push_actor_task_ordered(self, spec: TaskSpec):
+        """Issue the send in pump order; await the reply out-of-band.
+
+        Ordering contract: wire order == pump order == submission order; the
+        executor runs tasks in arrival order, so no sequence numbers are
+        needed (the reference's ActorTaskSubmitter/ActorSchedulingQueue pair
+        achieves the same with explicit seq_nos over unordered gRPC).
+        """
         entry = self._actor_conns.get(spec.actor_id)
         if entry is None:
-            entry = self._actor_conns[spec.actor_id] = {"addr": "", "conn": None, "seq": 0}
+            entry = self._actor_conns[spec.actor_id] = {"addr": "", "conn": None}
         try:
             if entry["conn"] is None or entry["conn"].closed:
                 if not entry["addr"]:
                     await self._refresh_actor_addr(spec.actor_id, entry)
                 entry["conn"] = await self._peer_conn(entry["addr"])
-            spec.seq_no = entry["seq"]
-            entry["seq"] += 1
+            fut = entry["conn"].call_start("push_actor_task", {"spec": spec})
+            # Backpressure: bound the transport buffer before the next send.
+            await entry["conn"].flush()
+        except ActorDiedError:
+            raise
+        except (rpc.ConnectionLost, rpc.RpcError):
+            # Stale address or send failure before execution could start:
+            # safe to retry through the reconnecting path (refreshes the
+            # address for restarted actors, honors max_task_retries).
+            entry["conn"] = None
+            entry["addr"] = ""
+            await self._push_actor_task(spec, attempt=0)
+            return
+        asyncio.create_task(self._await_actor_reply(spec, fut, entry))
+
+    async def _await_actor_reply(self, spec: TaskSpec, fut, entry):
+        try:
+            reply = await fut
+        except ActorDiedError as e:
+            self._fail_task_returns(spec, e)
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
+            # Connection dropped mid-flight: the task may or may not have
+            # executed. Resend ONLY if the user opted into retries
+            # (max_task_retries > 0) — otherwise at-most-once wins.
+            entry["conn"] = None
+            entry["addr"] = ""
+            if getattr(spec.options, "max_task_retries", 0) > 0:
+                await self._push_actor_task(spec, attempt=1)
+            else:
+                self._fail_task_returns(
+                    spec,
+                    ActorDiedError(
+                        f"actor {spec.actor_id.hex()[:8]} task {spec.method_name} lost in flight: {e}"
+                    ),
+                )
+        else:
+            self._absorb_task_reply(spec, reply)
+
+    async def _push_actor_task(self, spec: TaskSpec, attempt: int = 0):
+        entry = self._actor_conns.get(spec.actor_id)
+        if entry is None:
+            entry = self._actor_conns[spec.actor_id] = {"addr": "", "conn": None}
+        try:
+            if entry["conn"] is None or entry["conn"].closed:
+                if not entry["addr"]:
+                    await self._refresh_actor_addr(spec.actor_id, entry)
+                entry["conn"] = await self._peer_conn(entry["addr"])
             reply = await entry["conn"].call("push_actor_task", {"spec": spec})
-            fut = asyncio.get_running_loop().create_future()
-            fut.add_done_callback(lambda f: f.exception())
-            self._absorb_task_reply(spec, reply, fut)
+            self._absorb_task_reply(spec, reply)
         except ActorDiedError as e:
             self._fail_task_returns(spec, e)
         except (rpc.ConnectionLost, rpc.RpcError, KeyError) as e:

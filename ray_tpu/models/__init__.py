@@ -1,0 +1,20 @@
+"""ray_tpu.models: flagship model families, written mesh-first.
+
+Models are pure-JAX pytrees with *logical axis* annotations
+(ray_tpu.parallel.sharding): the same model code runs under any
+ShardingStrategy (DP/FSDP/TP/SP/EP) — the strategy decides how each logical
+axis maps onto the device mesh and XLA compiles in the collectives.
+"""
+from ray_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    cross_entropy_loss,
+    make_train_step,
+)
+
+__all__ = [
+    "Transformer",
+    "TransformerConfig",
+    "cross_entropy_loss",
+    "make_train_step",
+]

@@ -1,0 +1,397 @@
+"""Decoder-only transformer LM, written TPU-first.
+
+Design choices for the MXU/HBM (see /opt/skills/guides/pallas_guide.md):
+- bfloat16 activations, fp32 params/optimizer (casted per-matmul) so every
+  matmul tiles onto the 128x128 MXU at full rate.
+- Layers are *stacked* and iterated with ``lax.scan`` — one compiled layer
+  body regardless of depth, static shapes throughout.
+- Every weight and activation carries logical axes; the active
+  ``ShardingStrategy`` (ray_tpu.parallel) decides the mesh mapping, so this
+  one implementation serves DP, FSDP, Megatron-TP, sequence/context parallel
+  and expert parallel without modification.
+- Optional ``remat`` wraps the layer body in ``jax.checkpoint`` to trade
+  FLOPs for HBM.
+
+The reference has no model zoo of its own (it orchestrates torch/vLLM — see
+SURVEY.md §2.4); this model is the framework's flagship train/serve workload,
+playing the role MaxText plays for the reference's JaxTrainer
+(/root/reference/python/ray/train/v2/jax/jax_trainer.py:19).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.sharding import with_logical_constraint as wlc
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # GQA; None -> n_heads
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    # MoE: n_experts=0 -> dense FFN; else top-k routed experts (expert axis).
+    n_experts: int = 0
+    expert_top_k: int = 2
+    remat: bool = False
+    attention_impl: str = "auto"  # auto | flash | reference | ring
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def __post_init__(self):
+        assert self.d_model % self.n_heads == 0
+        assert self.n_heads % self.kv_heads == 0
+
+
+# ---------------------------------------------------------------------------
+# Parameter init + logical axes
+# ---------------------------------------------------------------------------
+
+def _dense_init(key, shape, dtype, in_axis=0):
+    """in_axis: int or tuple of axes whose product is the contraction fan-in."""
+    axes = (in_axis,) if isinstance(in_axis, int) else tuple(in_axis)
+    fan_in = 1
+    for a in axes:
+        fan_in *= shape[a]
+    scale = 1.0 / (fan_in ** 0.5)
+    return jax.random.normal(key, shape, dtype) * scale
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Stacked-layer parameter pytree (leading 'layers' dim on layer params)."""
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(key, 16))
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    H, KV, Hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+
+    layer = {
+        "attn_norm": jnp.ones((L, D), pd),
+        "wq": _dense_init(next(k), (L, D, H, Hd), pd, in_axis=1),
+        "wk": _dense_init(next(k), (L, D, KV, Hd), pd, in_axis=1),
+        "wv": _dense_init(next(k), (L, D, KV, Hd), pd, in_axis=1),
+        "wo": _dense_init(next(k), (L, H, Hd, D), pd, in_axis=(1, 2)),
+        "ffn_norm": jnp.ones((L, D), pd),
+    }
+    if cfg.n_experts:
+        E, EF = cfg.n_experts, F
+        layer.update(
+            {
+                "router": _dense_init(next(k), (L, D, E), pd, in_axis=1),
+                "w_gate": _dense_init(next(k), (L, E, D, EF), pd, in_axis=2),
+                "w_up": _dense_init(next(k), (L, E, D, EF), pd, in_axis=2),
+                "w_down": _dense_init(next(k), (L, E, EF, D), pd, in_axis=2),
+            }
+        )
+    else:
+        layer.update(
+            {
+                "w_gate": _dense_init(next(k), (L, D, F), pd, in_axis=1),
+                "w_up": _dense_init(next(k), (L, D, F), pd, in_axis=1),
+                "w_down": _dense_init(next(k), (L, F, D), pd, in_axis=1),
+            }
+        )
+    return {
+        "embed": _dense_init(next(k), (cfg.vocab_size, D), pd) * (D ** 0.5),
+        "layers": layer,
+        "final_norm": jnp.ones((D,), pd),
+        "lm_head": _dense_init(next(k), (D, cfg.vocab_size), pd, in_axis=0),
+    }
+
+
+def param_logical_axes(cfg: TransformerConfig) -> dict:
+    """Same-structure pytree of logical-axis tuples (see LOGICAL_AXES)."""
+    layer = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "ffn_norm": ("layers", "embed"),
+    }
+    if cfg.n_experts:
+        layer.update(
+            {
+                "router": ("layers", "embed", None),
+                "w_gate": ("layers", "experts", "embed", "expert_mlp"),
+                "w_up": ("layers", "experts", "embed", "expert_mlp"),
+                "w_down": ("layers", "experts", "expert_mlp", "embed"),
+            }
+        )
+    else:
+        layer.update(
+            {
+                "w_gate": ("layers", "embed", "mlp"),
+                "w_up": ("layers", "embed", "mlp"),
+                "w_down": ("layers", "mlp", "embed"),
+            }
+        )
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _rms_norm(x, w, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + eps).astype(x.dtype)) * w.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """x: [B, S, H, Hd]; rotate pairs (even, odd) halves."""
+    half = x.shape[-1] // 2
+    freqs = jnp.exp(
+        -jnp.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, positions=None):
+    """Dispatch to the configured attention implementation."""
+    impl = cfg.attention_impl
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "reference"
+    if impl == "flash":
+        from ray_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, axis_name="seq", causal=True)
+    from ray_tpu.ops.attention import mha_reference
+
+    return mha_reference(q, k, v, causal=True)
+
+
+def _dense_ffn(x, p):
+    gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"].astype(x.dtype))
+    up = jnp.einsum("bsd,df->bsf", x, p["w_up"].astype(x.dtype))
+    h = jax.nn.silu(gate) * up
+    h = wlc(h, ("batch", "seq", "mlp"))
+    return jnp.einsum("bsf,fd->bsd", h, p["w_down"].astype(x.dtype))
+
+
+def _moe_ffn(x, p, cfg: TransformerConfig):
+    """Top-k routed MoE. Experts carry the 'experts' logical axis; under the
+    EP strategy the einsum over the expert dim induces an all_to_all.
+
+    Dense-dispatch formulation (every token weighted to every expert with a
+    sparse weight matrix) — compiler-friendly: static shapes, no gather along
+    the token axis, and XLA shards the expert dim cleanly.
+    """
+    B, S, D = x.shape
+    E, K = cfg.n_experts, cfg.expert_top_k
+    logits = jnp.einsum("bsd,de->bse", x, p["router"].astype(x.dtype))
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_w, top_idx = lax.top_k(weights, K)  # [B,S,K]
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    # combine [B,S,E] sparse routing matrix
+    route = jnp.sum(
+        jax.nn.one_hot(top_idx, E, dtype=jnp.float32) * top_w[..., None], axis=2
+    )
+    route = route.astype(x.dtype)
+    # expert compute: xe [E, B, S, D] weighted inputs would be huge; instead
+    # compute all experts on all tokens is O(E*tokens) — fine for small E on
+    # bench; for large E the EP strategy shards the E dim across chips.
+    gate = jnp.einsum("bsd,edf->ebsf", x, p["w_gate"].astype(x.dtype))
+    up = jnp.einsum("bsd,edf->ebsf", x, p["w_up"].astype(x.dtype))
+    h = jax.nn.silu(gate) * up
+    h = wlc(h, ("experts", "batch", "seq", "expert_mlp"))
+    out = jnp.einsum("ebsf,efd->ebsd", h, p["w_down"].astype(x.dtype))
+    out = jnp.einsum("ebsd,bse->bsd", out, route)
+    aux = _load_balance_loss(weights, top_idx, E)
+    return out, aux
+
+
+def _load_balance_loss(weights, top_idx, n_experts):
+    """Switch-transformer aux loss: mean_prob * mean_assignment per expert."""
+    me = jnp.mean(weights, axis=(0, 1))  # [E]
+    ce = jnp.mean(
+        jax.nn.one_hot(top_idx[..., 0], n_experts, dtype=jnp.float32), axis=(0, 1)
+    )
+    return n_experts * jnp.sum(me * ce)
+
+
+def _layer(x, lp, cfg: TransformerConfig, positions):
+    """One decoder block. x: [B, S, D] in cfg.dtype."""
+    dt = x.dtype
+    h = _rms_norm(x, lp["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt))
+    q = wlc(q, ("batch", "seq", "heads", "head_dim"))
+    k = wlc(k, ("batch", "seq", "kv_heads", "head_dim"))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if cfg.kv_heads != cfg.n_heads:
+        rep = cfg.n_heads // cfg.kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    o = _attention(q, k, v, cfg, positions)
+    o = wlc(o, ("batch", "seq", "heads", "head_dim"))
+    attn_out = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
+    x = x + attn_out
+    h = _rms_norm(x, lp["ffn_norm"])
+    if cfg.n_experts:
+        ffn_out, aux = _moe_ffn(h, lp, cfg)
+    else:
+        ffn_out, aux = _dense_ffn(h, lp), jnp.zeros((), jnp.float32)
+    x = x + ffn_out
+    x = wlc(x, ("batch", "seq", "embed"))
+    return x, aux
+
+
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = wlc(x, ("batch", "seq", "embed"))
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+    body = functools.partial(_layer, cfg=cfg, positions=positions)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(carry, lp):
+        y, aux = body(carry, lp)
+        return y, aux
+
+    x, auxes = lax.scan(scan_fn, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype))
+    logits = wlc(logits, ("batch", "seq", "vocab"))
+    # Keep logits in activation dtype: at vocab=32k the fp32 copy alone is
+    # O(GBs) of HBM; the loss upcasts per-reduction instead.
+    return logits, jnp.sum(auxes)
+
+
+def cross_entropy_loss(params, batch, cfg: TransformerConfig):
+    """batch: {"tokens": [B, S+1] int32} -> scalar mean NLL (+ MoE aux)."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = forward(params, inputs, cfg)
+    # logsumexp-form CE: avoids materializing a full [B,S,V] log_softmax.
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - picked.astype(jnp.float32)
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(jnp.float32)
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        loss = jnp.mean(nll)
+    return loss + 0.01 * aux
+
+
+# ---------------------------------------------------------------------------
+# Train step factory
+# ---------------------------------------------------------------------------
+
+def make_train_step(cfg: TransformerConfig, optimizer=None):
+    """Returns (init_state, train_step, state_logical_axes).
+
+    train_step(state, batch) -> (state, metrics); pure + jittable, composes
+    with any mesh/strategy via ray_tpu.parallel.shard_pytree on the state.
+    """
+    import optax
+
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+
+    def init_state(key):
+        params = init_params(key, cfg)
+        return {"params": params, "opt": optimizer.init(params), "step": jnp.zeros((), jnp.int32)}
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(cross_entropy_loss)(
+            state["params"], batch, cfg
+        )
+        updates, opt = optimizer.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        gnorm = optax.global_norm(grads)
+        return (
+            {"params": params, "opt": opt, "step": state["step"] + 1},
+            {"loss": loss, "grad_norm": gnorm, "step": state["step"] + 1},
+        )
+
+    def state_logical_axes(state):
+        p_axes = param_logical_axes(cfg)
+        return {
+            "params": p_axes,
+            "opt": _opt_axes_like(state["opt"], p_axes),
+            "step": (),
+        }
+
+    return init_state, train_step, state_logical_axes
+
+
+def _opt_axes_like(opt_state, p_axes):
+    """Optimizer state mirrors param structure (adam mu/nu); scalars -> ().
+
+    Walk the opt_state; any subtree with the params' treedef gets p_axes,
+    everything else (counts, scalars) gets ().
+    """
+    import jax
+
+    def recurse(node):
+        try:
+            if jax.tree.structure(node) == jax.tree.structure(
+                jax.tree.map(lambda a: 0, p_axes, is_leaf=lambda x: isinstance(x, tuple))
+            ):
+                return p_axes
+        except Exception:
+            pass
+        if isinstance(node, (list, tuple)) and not hasattr(node, "_fields"):
+            return type(node)(recurse(c) for c in node)
+        if hasattr(node, "_fields"):  # NamedTuple (optax states)
+            return type(node)(*(recurse(getattr(node, f)) for f in node._fields))
+        if isinstance(node, dict):
+            return {k: recurse(v) for k, v in node.items()}
+        return ()
+
+    return recurse(opt_state)
+
+
+class Transformer:
+    """OO convenience wrapper over the functional API."""
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    def init(self, key):
+        return init_params(key, self.cfg)
+
+    def apply(self, params, tokens):
+        logits, _ = forward(params, tokens, self.cfg)
+        return logits
+
+    @property
+    def param_axes(self):
+        return param_logical_axes(self.cfg)

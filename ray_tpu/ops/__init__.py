@@ -1,0 +1,8 @@
+"""ray_tpu.ops: Pallas TPU kernels for the hot ops.
+
+Each op ships a pure-jnp reference implementation (used on CPU test meshes and
+as the numerical oracle) and a Pallas TPU kernel used on real hardware.
+"""
+from ray_tpu.ops.attention import flash_attention, mha_reference
+
+__all__ = ["flash_attention", "mha_reference"]

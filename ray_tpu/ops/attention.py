@@ -1,0 +1,377 @@
+"""Flash attention for TPU: Pallas forward + backward kernels.
+
+Memory-bound op #1 in the transformer. The kernel streams K/V blocks through
+VMEM with an online-softmax accumulator so the S×S score matrix never touches
+HBM (HBM traffic O(S·D) instead of O(S²)). Forward saves the per-row
+log-sum-exp so the backward pass recomputes probabilities blockwise.
+
+Layout: kernels operate on [BH, S, D] (batch*heads folded into the leading
+grid axis); blocks are (block_q × D) / (block_k × D) with D padded to a lane
+multiple of 128 by the caller's head_dim choice. Grid iteration order puts the
+K-block axis innermost ("arbitrary") so the f32 accumulators live in VMEM
+scratch across K steps (pallas_guide.md: Grid and Block Specifications).
+
+The reference framework has no attention kernels (compute is delegated to
+torch/vLLM, SURVEY.md §2.4); functional parity target is the standard flash
+attention contract (causal MHA with LSE residuals).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (numerical oracle + CPU fallback)
+# ---------------------------------------------------------------------------
+
+def mha_reference(q, k, v, causal=True, scale=None):
+    """q,k,v: [B, S, H, D] -> [B, S, H, D]. Softmax in f32."""
+    *_, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        S_q, S_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((S_q, S_k), bool), k=S_k - S_q)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, block_q, block_k, n_k, causal):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:, :] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:, :] = jnp.zeros_like(l_scr)
+        acc_scr[:, :] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0, :, :]
+        k = k_ref[0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc_scr[:, :] = acc_scr[:, :] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, :, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, :] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
+        l_scr[:, :] = jnp.broadcast_to(l_cur[:, None], l_scr.shape)
+
+    if causal:
+        # Skip blocks strictly above the diagonal.
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, :] = (acc_scr[:, :] / l_safe[:, None]).astype(o_ref.dtype)
+        lse = m_scr[:, 0] + jnp.log(l_safe)  # [bq]
+        # lse is materialized as [BH, 8, S] (8 sublanes to satisfy the
+        # (8, 128) min-tile rule); broadcast the row across sublanes.
+        lse_ref[0, :, :] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
+
+
+def _fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
+    """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, S] f32)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    n_q = pl.cdiv(S, block_q)
+    n_k = pl.cdiv(S, block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, n_k=n_k, causal=causal
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 8, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward (dk/dv kernel + dq kernel)
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    dk_scr, dv_scr, *, scale, block_q, block_k, n_q, causal):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:, :] = jnp.zeros_like(dk_scr)
+        dv_scr[:, :] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0, :, :]
+        k = k_ref[0, :, :]
+        v = v_ref[0, :, :]
+        do = do_ref[0, :, :]
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk] f32
+        # dv += p^T @ do
+        dv_scr[:, :] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dp = do @ v^T ; ds = p * (dp - delta)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[:, :] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0, :, :] = dk_scr[:, :].astype(dk_ref.dtype)
+        dv_ref[0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, block_q, block_k, n_k, causal):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:, :] = jnp.zeros_like(dq_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0, :, :]
+        k = k_ref[0, :, :]
+        v = v_ref[0, :, :]
+        do = do_ref[0, :, :]
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[:, :] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0, :, :] = dq_scr[:, :].astype(dq_ref.dtype)
+
+
+def _bwd_pallas(res, g, *, causal, scale, block_q, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, o, lse = res
+    do = g
+    BH, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    n_q = pl.cdiv(S, block_q)
+    n_k = pl.cdiv(S, block_k)
+
+    delta_row = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta_row[:, None, :], (BH, 8, S))  # sublane-tiled like lse
+
+    dkv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k, n_q=n_q, causal=causal
+        ),
+        grid=(BH, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, ki, qi: (b, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda b, ki, qi: (b, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v, do, lse, delta)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k, n_k=n_k, causal=causal
+        ),
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, causal, scale, block_q, block_k):
+    o, _ = _fwd_pallas(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    o, lse = _fwd_pallas(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, g):
+    return _bwd_pallas(res, g, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+
+
+_flash_bhsd.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=True, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention. q,k,v: [B, S, H, D] -> [B, S, H, D].
+
+    Uses the Pallas kernels on TPU; falls back to the jnp reference elsewhere
+    (CPU test meshes). S must be a multiple of 128 for the TPU path (callers
+    pad); D should be a lane multiple (64/128/256).
+    """
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if jax.default_backend() != "tpu" or S % 128 != 0:
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    # Blocks must divide S exactly: Pallas pads out-of-bounds block reads with
+    # undefined data, and the non-causal path applies no mask that would
+    # neutralize padded key columns. S is a multiple of 128 here, so halving
+    # always converges to a divisor.
+    while S % block_q:
+        block_q //= 2
+    while S % block_k:
+        block_k //= 2
+    # [B,S,H,D] -> [B*H, S, D]
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    unfold = lambda x: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    o = _flash_bhsd(fold(q), fold(k), fold(v), causal, scale, block_q, block_k)
+    return unfold(o)

@@ -1,0 +1,27 @@
+"""ray_tpu.parallel: TPU-native parallelism (mesh, sharding strategies, pipeline).
+
+This is the TPU replacement for the reference's parallelism plumbing: where the
+reference orchestrates torch DDP/FSDP wrappers and passes TP/PP degrees to vLLM
+(see SURVEY.md §2.4 "Parallelism strategies"), here parallelism is expressed as
+GSPMD sharding over a `jax.sharding.Mesh` and compiled into the program by XLA.
+"""
+from ray_tpu.parallel.mesh import MeshSpec, create_mesh, local_mesh, mesh_shape_for
+from ray_tpu.parallel.sharding import (
+    LOGICAL_AXES,
+    ShardingStrategy,
+    logical_sharding,
+    shard_pytree,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "LOGICAL_AXES",
+    "MeshSpec",
+    "ShardingStrategy",
+    "create_mesh",
+    "local_mesh",
+    "logical_sharding",
+    "mesh_shape_for",
+    "shard_pytree",
+    "with_logical_constraint",
+]

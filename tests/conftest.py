@@ -6,11 +6,18 @@ topology"); real TPU runs are reserved for bench.py.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of ambient JAX_PLATFORMS (the env tunnels one real TPU
+# chip and its sitecustomize overrides the env var; tests must run on the
+# virtual 8-device CPU mesh, bench.py on the TPU).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAYTPU_OBJECT_STORE_MEMORY", str(64 * 1024 * 1024))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
