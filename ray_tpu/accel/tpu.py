@@ -164,3 +164,122 @@ def detect_tpu_resources() -> tuple[dict, dict]:
         if worker_id == 0 and acc_type:
             resources[TPUAcceleratorManager.slice_head_resource(acc_type)] = 1.0
     return resources, labels
+
+
+# ---------------------------------------------------------------------------
+# Slice gang reservation (reference: reserve_tpu_slice, tpu.py:224 +
+# SlicePlacementGroup, util/tpu.py:181)
+# ---------------------------------------------------------------------------
+
+
+class SliceReservation:
+    """A held TPU slice: slice-name label selector + the head-resource PG
+    that locks the slice. Release it when the gang is torn down, or the
+    slice stays locked against future reservations (incl. our own gang
+    restart)."""
+
+    def __init__(self, label_selector: dict, head_pg):
+        self.label_selector = label_selector
+        self.head_pg = head_pg
+        self._released = False
+
+    def release(self):
+        if self._released or self.head_pg is None:
+            return
+        self._released = True
+        import ray_tpu as rt
+
+        try:
+            rt.remove_placement_group(self.head_pg)
+        except Exception:
+            pass
+
+
+def reserve_tpu_slice(accelerator_type: str, topology: Optional[str] = None,
+                      num_slices: int = 1, timeout: float = 60.0) -> Optional[SliceReservation]:
+    """Reserve whole TPU slice(s) for gang scheduling.
+
+    Places one bundle per slice on the slice-head resource (``TPU-{pod}-head``,
+    advertised only by worker 0 of each slice, STRICT_SPREAD so each bundle
+    locks a distinct slice), then reads each head node's slice-name label.
+    Returns None when no slice-head resource exists in the cluster (CPU test
+    topologies without TPU labels).
+    """
+    import ray_tpu as rt
+
+    if topology is not None:
+        dims = validate_topology(topology)
+        chips = 1
+        for d in dims:
+            chips *= d
+        expect = get_num_tpu_chips(accelerator_type)
+        if chips != expect:
+            raise ValueError(
+                f"topology {topology} has {chips} chips but {accelerator_type} has {expect}"
+            )
+    head_res = TPUAcceleratorManager.slice_head_resource(accelerator_type)
+    if rt.cluster_resources().get(head_res, 0) < num_slices:
+        return None
+    pg = rt.placement_group(
+        [{head_res: 1.0} for _ in range(num_slices)],
+        strategy="STRICT_SPREAD" if num_slices > 1 else "STRICT_PACK",
+        name=f"slice-{accelerator_type}",
+    )
+    if not pg.ready(timeout=timeout):
+        rt.remove_placement_group(pg)
+        raise TimeoutError(
+            f"no {num_slices} free {accelerator_type} slice(s) (resource {head_res})"
+        )
+    node_labels = {n["NodeID"]: n.get("labels", {}) for n in rt.nodes()}
+    names = [
+        node_labels.get(nid, {}).get(TPU_SLICE_NAME_LABEL)
+        for nid in pg.bundle_nodes()
+    ]
+    names = [n for n in names if n]
+    if not names:
+        return SliceReservation({}, pg)
+    # Selector syntax per the controller's matcher: "v" or "in(a,b)".
+    selector = {
+        TPU_SLICE_NAME_LABEL: names[0] if len(names) == 1 else f"in({','.join(names)})"
+    }
+    return SliceReservation(selector, pg)
+
+
+class SlicePlacementGroup:
+    """Multi-host slice gang: one bundle per TPU host, STRICT_SPREAD and
+    label-pinned to the reserved slice(s) (reference: util/tpu.py:181)."""
+
+    def __init__(self, accelerator_type: str, topology: Optional[str] = None,
+                 num_slices: int = 1):
+        import ray_tpu as rt
+
+        self.accelerator_type = accelerator_type
+        self.num_hosts = get_num_hosts(accelerator_type) * num_slices
+        chips = get_chips_per_host(accelerator_type)
+        self.reservation = reserve_tpu_slice(
+            accelerator_type, topology, num_slices=num_slices
+        )
+        selector = self.reservation.label_selector if self.reservation else {}
+        self.pg = rt.placement_group(
+            [{"TPU": float(chips)} for _ in range(self.num_hosts)],
+            strategy="STRICT_SPREAD" if self.num_hosts > 1 else "PACK",
+            name=f"slice-pg-{accelerator_type}",
+            label_selector=selector,
+        )
+
+    @property
+    def label_selector(self) -> dict:
+        return self.reservation.label_selector if self.reservation else {}
+
+    def ready(self, timeout: float = 60.0) -> bool:
+        return self.pg.ready(timeout=timeout)
+
+    def release(self):
+        import ray_tpu as rt
+
+        try:
+            rt.remove_placement_group(self.pg)
+        except Exception:
+            pass
+        if self.reservation:
+            self.reservation.release()

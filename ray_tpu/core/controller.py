@@ -76,6 +76,10 @@ class PGRecord:
     name: str = ""
     job_id: Optional[JobID] = None
     pending_waiters: list = field(default_factory=list)
+    # Gang label constraint: every bundle lands only on nodes matching this
+    # (reference: LabelSelector in bundle scheduling — label_selector.h used
+    # by TPU-slice gang reservation, SURVEY §2.1).
+    label_selector: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -693,6 +697,7 @@ class Controller:
             strategy=p["strategy"],
             name=p.get("name", ""),
             job_id=p.get("job_id"),
+            label_selector=p.get("label_selector") or {},
         )
         self.pgs[pg.pg_id] = pg
         await self._schedule_pg(pg)
@@ -729,6 +734,8 @@ class Controller:
 
     def _plan_bundles(self, pg: PGRecord) -> Optional[list]:
         nodes = [n for n in self.nodes.values() if n.state == "ALIVE"]
+        if pg.label_selector:
+            nodes = [n for n in nodes if _labels_match(n.labels, pg.label_selector)]
         nodes.sort(key=lambda n: n.node_id)
         avail = {n.node_id: dict(n.resources_available) for n in nodes}
         byid = {n.node_id: n for n in nodes}

@@ -150,7 +150,13 @@ class NodeDaemon:
         env["RAYTPU_NODE_ID"] = self.node_id
         env.setdefault("PYTHONPATH", "")
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = repo_root + (os.pathsep + env["PYTHONPATH"] if env["PYTHONPATH"] else "")
+        # Propagate the daemon/driver interpreter's sys.path so functions
+        # pickled by reference (module-level fns in driver-side modules)
+        # resolve in workers — the runtime-env equivalent of the reference's
+        # working_dir/py_modules propagation (_private/runtime_env/).
+        driver_path = os.pathsep.join(p for p in sys.path if p)
+        parts = [repo_root, driver_path, env["PYTHONPATH"]]
+        env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,

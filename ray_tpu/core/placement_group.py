@@ -53,7 +53,10 @@ class PlacementGroup:
         return (PlacementGroup, (self.id, self.bundles, self.strategy))
 
 
-def placement_group(bundles: list[dict], strategy: str = "PACK", name: str = "", wait: bool = False) -> PlacementGroup:
+def placement_group(bundles: list[dict], strategy: str = "PACK", name: str = "",
+                    wait: bool = False, label_selector: Optional[dict] = None) -> PlacementGroup:
+    """label_selector constrains every bundle to nodes matching the labels
+    (TPU-slice gang pinning; reference LabelSelector + PG trick, SURVEY §7.4)."""
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
     if not bundles or any(not b for b in bundles):
@@ -65,7 +68,8 @@ def placement_group(bundles: list[dict], strategy: str = "PACK", name: str = "",
     core._run(
         core.controller.call(
             "create_placement_group",
-            {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name, "job_id": core.job_id, "wait": wait},
+            {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name,
+             "job_id": core.job_id, "wait": wait, "label_selector": label_selector or {}},
         )
     )
     return PlacementGroup(pg_id, bundles, strategy)

@@ -51,7 +51,10 @@ class _RefAwarePickler(cloudpickle.CloudPickler):
             if _context.on_ref_serialized is not None:
                 _context.on_ref_serialized(obj)
             return obj.__reduce__()
-        return NotImplemented
+        # Delegate to CloudPickler's override — that's where by-value
+        # pickling of local functions/classes lives; returning
+        # NotImplemented here would silently drop it.
+        return super().reducer_override(obj)
 
 
 def serialize(value: Any) -> tuple[bytes, list]:

@@ -749,7 +749,7 @@ class CoreWorker:
                 self._mark_ready(oid, size=len(item["inline"]), in_memory=True, in_shm=False)
             else:
                 self._mark_ready(oid, size=item.get("size", 0), in_memory=False, in_shm=True)
-        if not fut.done():
+        if fut is not None and not fut.done():
             fut.set_result(True)
 
     # -- task execution (executor side) --------------------------------

@@ -15,6 +15,13 @@ import sys
 
 def main():
     logging.basicConfig(level=os.environ.get("RAYTPU_LOG_LEVEL", "WARNING"))
+    # Test harnesses force a platform (e.g. the virtual CPU mesh) that must
+    # survive site hooks which pre-register an accelerator backend.
+    forced = os.environ.get("RAYTPU_FORCE_JAX_PLATFORM")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
     from ray_tpu.core.worker import CoreWorker
 
     controller_addr = os.environ["RAYTPU_CONTROLLER_ADDR"]

@@ -1,0 +1,33 @@
+"""ray_tpu.train: distributed training orchestration (JaxTrainer-equivalent).
+
+Public surface mirrors the reference's ray.train v2 API (SURVEY.md §2.4):
+trainers, ScalingConfig/RunConfig/FailureConfig/CheckpointConfig, Checkpoint,
+report()/get_context()/get_checkpoint() from inside the train fn.
+"""
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.controller import Result, TrainController
+from ray_tpu.train.session import TrainContext, get_checkpoint, get_context, report
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "TrainController",
+    "TrainWorker",
+    "WorkerGroup",
+    "get_checkpoint",
+    "get_context",
+    "load_pytree",
+    "report",
+    "save_pytree",
+]

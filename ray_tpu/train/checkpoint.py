@@ -1,0 +1,161 @@
+"""Checkpoints: directory-based user API + controller-side top-K manager.
+
+Role-equivalent to the reference's ray.train Checkpoint (train/_checkpoint.py:56
+— "a directory + a pyarrow.fs URI") and CheckpointManager
+(train/v2/_internal/execution/checkpoint/checkpoint_manager.py:72 — top-K
+retention keyed on a score attribute). Sharded-array state goes through
+orbax (save_pytree/load_pytree) so a mesh-sharded train state round-trips.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Optional
+
+
+class Checkpoint:
+    """A checkpoint is a directory. Construct with from_directory()."""
+
+    def __init__(self, path: str, metrics: Optional[dict] = None):
+        self.path = path
+        self.metrics = metrics or {}
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="raytpu_ckpt_")
+        if os.path.abspath(dest) != os.path.abspath(self.path):
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def save_pytree(tree: Any, path: str):
+    """Persist a (possibly sharded) jax pytree with orbax."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_pytree(path: str, like: Any = None) -> Any:
+    """Restore a pytree; pass ``like`` (abstract or concrete tree) to restore
+    with target shardings."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    if like is not None:
+        return ckptr.restore(os.path.abspath(path), like)
+    return ckptr.restore(os.path.abspath(path))
+
+
+class CheckpointManager:
+    """Tracks reported checkpoints under storage_path, keeps top-K."""
+
+    def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._index = 0
+        # list of (score, index, Checkpoint); score None -> recency ordering
+        self._checkpoints: list[tuple[Any, int, Checkpoint]] = []
+        os.makedirs(storage_path, exist_ok=True)
+        self._load_state()
+
+    # -- persistence of the manager's own state (controller restart) -------
+    def _state_file(self) -> str:
+        return os.path.join(self.storage_path, "checkpoint_manager.json")
+
+    def _load_state(self):
+        try:
+            with open(self._state_file()) as f:
+                st = json.load(f)
+            self._index = st["index"]
+            self._checkpoints = [
+                (c["score"], c["index"], Checkpoint(c["path"], c.get("metrics")))
+                for c in st["checkpoints"]
+                if os.path.isdir(c["path"])
+            ]
+        except (OSError, ValueError, KeyError):
+            pass
+
+    def _save_state(self):
+        st = {
+            "index": self._index,
+            "checkpoints": [
+                {"score": s, "index": i, "path": c.path, "metrics": c.metrics}
+                for s, i, c in self._checkpoints
+            ],
+        }
+        tmp = self._state_file() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(st, f)
+        os.replace(tmp, self._state_file())
+
+    # -- registration ------------------------------------------------------
+    def register(self, src_dir: str, metrics: dict) -> Checkpoint:
+        """Copy a worker-produced checkpoint dir into managed storage."""
+        self._index += 1
+        dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
+        if os.path.abspath(src_dir) != dest:
+            shutil.copytree(src_dir, dest, dirs_exist_ok=True)
+        ckpt = Checkpoint(dest, dict(metrics))
+        score = metrics.get(self.score_attribute) if self.score_attribute else None
+        self._checkpoints.append((score, self._index, ckpt))
+        self._evict()
+        self._save_state()
+        return ckpt
+
+    def _evict(self):
+        if self.num_to_keep is None or len(self._checkpoints) <= self.num_to_keep:
+            return
+
+        def quality(t):
+            score, index, _ = t
+            if self.score_attribute:
+                if score is None:
+                    return (0, index)  # unscored: worst tier, recency tiebreak
+                return (1, score if self.score_order == "max" else -score)
+            return (1, index)  # no score attribute: keep most recent
+
+        ranked = sorted(self._checkpoints, key=quality, reverse=True)
+        keep = ranked[: self.num_to_keep]
+        for s, i, c in self._checkpoints:
+            if (s, i, c) not in keep:
+                shutil.rmtree(c.path, ignore_errors=True)
+        self._checkpoints = [t for t in self._checkpoints if t in keep]
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._checkpoints:
+            return None
+        return max(self._checkpoints, key=lambda t: t[1])[2]
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self._checkpoints:
+            return None
+        if not self.score_attribute:
+            return self.latest
+        scored = [t for t in self._checkpoints if t[0] is not None]
+        if not scored:
+            return self.latest
+        pick = max if self.score_order == "max" else min
+        return pick(scored, key=lambda t: t[0])[2]

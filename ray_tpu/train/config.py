@@ -1,0 +1,61 @@
+"""Train configs: ScalingConfig / RunConfig / FailureConfig / CheckpointConfig.
+
+Role-equivalent to the reference's ray.train v2 configs
+(/root/reference/python/ray/train/v2/api/config.py:60-112 ScalingConfig with
+use_tpu/topology/accelerator_type; RunConfig; FailureConfig). TPU fields are
+first-class: a ScalingConfig names a slice topology and the controller turns
+it into a gang placement group over slice hosts (SlicePlacementGroup).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    # TPU slice shape, e.g. accelerator_type="v5p-16", topology="2x2x2".
+    accelerator_type: Optional[str] = None
+    topology: Optional[str] = None
+    num_slices: int = 1
+    resources_per_worker: dict = dataclasses.field(default_factory=dict)
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> dict:
+        res = dict(self.resources_per_worker)
+        if self.use_tpu and self.accelerator_type and "TPU" not in res:
+            from ray_tpu.accel import tpu as tpu_mod
+
+            res["TPU"] = float(tpu_mod.get_chips_per_host(self.accelerator_type))
+        if not res and not self.use_tpu:
+            res = {"CPU": 1.0}
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0  # gang restarts permitted; -1 = unlimited
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None  # None = keep all
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # or "min"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "raytpu_results"
+        )
+        return os.path.join(base, self.name or "train_run")

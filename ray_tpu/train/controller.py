@@ -1,0 +1,173 @@
+"""TrainController: the training run's state machine.
+
+Role-equivalent to the reference's TrainController actor
+(/root/reference/python/ray/train/v2/_internal/execution/controller/
+controller.py:102; responsibilities listed at :103-112): start the worker
+gang, poll it, funnel reports into the CheckpointManager, and apply the
+FailurePolicy — SPMD gang semantics, so ANY worker failure restarts the WHOLE
+group from the latest checkpoint (reference failure_handling/ + the
+gang-restart behavior of v2).
+
+Runs as an actor (like the reference, pinned near the driver) so a driver
+process crash doesn't orphan the gang silently; `TrainRunner` below is the
+driver-side blocking wrapper.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclasses.dataclass
+class Result:
+    """Reference: ray.train.Result (metrics + best/latest checkpoint + error)."""
+
+    metrics: dict
+    checkpoint: Optional[Checkpoint]
+    best_checkpoint: Optional[Checkpoint]
+    error: Optional[str]
+    metrics_history: list
+
+    @property
+    def success(self) -> bool:
+        return self.error is None
+
+
+class TrainController:
+    """State machine: INIT -> RUNNING -> (RESTARTING -> RUNNING)* -> DONE|ERRORED."""
+
+    def __init__(self, train_fn: Callable, train_config: dict,
+                 scaling: ScalingConfig, run_config: RunConfig,
+                 poll_interval_s: float = 0.2, settle_period_s: float = 5.0):
+        self.train_fn = train_fn
+        self.train_config = train_config
+        self.scaling = scaling
+        self.run_config = run_config
+        self.poll_interval_s = poll_interval_s
+        self.settle_period_s = settle_period_s
+        self.storage_path = run_config.resolved_storage_path()
+        cc = run_config.checkpoint_config
+        self.ckpt_manager = CheckpointManager(
+            self.storage_path,
+            num_to_keep=cc.num_to_keep,
+            score_attribute=cc.checkpoint_score_attribute,
+            score_order=cc.checkpoint_score_order,
+        )
+        self.state = "INIT"
+        self.failures = 0
+        self.metrics_history: list[dict] = []
+        self.latest_metrics: dict = {}
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> Result:
+        error: Optional[str] = None
+        group: Optional[WorkerGroup] = None
+        name = self.run_config.name or "train_run"
+        max_failures = self.run_config.failure_config.max_failures
+        while True:
+            try:
+                if group is None:
+                    group = WorkerGroup(self.scaling, name, self.storage_path)
+                    group.start()
+                    resume = self.ckpt_manager.latest
+                    group.run(
+                        self.train_fn,
+                        self.train_config,
+                        resume.path if resume else None,
+                    )
+                    self.state = "RUNNING"
+                status = group.poll()
+            except Exception:
+                status = None
+                err_text = traceback.format_exc()
+                if group is not None:
+                    # Drain surviving ranks' reports first — rank 0's last
+                    # persisted checkpoint is the restart point.
+                    try:
+                        self._absorb_reports(group.poll())
+                    except Exception:
+                        pass
+                    group.shutdown()
+                group = None
+                self.failures += 1
+                if max_failures != -1 and self.failures > max_failures:
+                    error = f"worker group failed:\n{err_text}"
+                    self.state = "ERRORED"
+                    break
+                self.state = "RESTARTING"
+                continue
+
+            worker_error = next((s["error"] for s in status if s["error"]), None)
+            self._absorb_reports(status)
+            if worker_error is not None:
+                # Let surviving ranks settle (finish or fail) before teardown
+                # so their last checkpoints are absorbed — restarting the
+                # gang without rank 0's newest checkpoint replays work and
+                # can re-hit the same failure.
+                deadline = time.monotonic() + self.settle_period_s
+                while time.monotonic() < deadline and not all(
+                    s["finished"] or s["error"] for s in status
+                ):
+                    time.sleep(self.poll_interval_s)
+                    try:
+                        status = group.poll()
+                        self._absorb_reports(status)
+                    except Exception:
+                        break
+                group.shutdown()
+                group = None
+                self.failures += 1
+                if max_failures != -1 and self.failures > max_failures:
+                    error = worker_error
+                    self.state = "ERRORED"
+                    break
+                self.state = "RESTARTING"
+                continue
+            if all(s["finished"] for s in status):
+                self.state = "DONE"
+                break
+            time.sleep(self.poll_interval_s)
+
+        if group is not None:
+            group.shutdown()
+        return Result(
+            metrics=self.latest_metrics,
+            checkpoint=self.ckpt_manager.latest,
+            best_checkpoint=self.ckpt_manager.best,
+            error=error,
+            metrics_history=self.metrics_history,
+        )
+
+    def _absorb_reports(self, status: list[dict]) -> None:
+        # Group per-worker reports by seq; rank 0's metrics are canonical
+        # (SPMD), checkpoints may come from any rank (rank 0 by convention).
+        by_seq: dict[int, dict] = {}
+        for st in status:
+            for rep in st["reports"]:
+                ent = by_seq.setdefault(rep["seq"], {"metrics": None, "ckpt": None})
+                if rep["world_rank"] == 0:
+                    ent["metrics"] = rep["metrics"]
+                if rep.get("checkpoint_dir"):
+                    ent["ckpt"] = (rep["checkpoint_dir"], rep["metrics"])
+        for seq in sorted(by_seq):
+            ent = by_seq[seq]
+            metrics = ent["metrics"] or (ent["ckpt"][1] if ent["ckpt"] else {})
+            if ent["ckpt"]:
+                self.ckpt_manager.register(ent["ckpt"][0], metrics)
+            if metrics:
+                self.metrics_history.append(metrics)
+                self.latest_metrics = metrics
+
+    def get_state(self) -> dict:
+        return {
+            "state": self.state,
+            "failures": self.failures,
+            "reported": len(self.metrics_history),
+            "latest_metrics": self.latest_metrics,
+        }

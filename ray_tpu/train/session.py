@@ -1,0 +1,113 @@
+"""Per-worker train session: rank info, report(), checkpoint access.
+
+Role-equivalent to the reference's ray.train session/context
+(train.report / train.get_context, python/ray/train/v2/_internal/execution/
+context.py): the user train fn calls ``ray_tpu.train.report(metrics,
+checkpoint=...)``; the session persists the checkpoint synchronously (the
+reference blocks on persistence too) and queues the report for the
+controller's next poll.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session: "TrainSession | None" = None
+_session_lock = threading.Lock()
+
+
+class TrainSession:
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 experiment_name: str, storage_path: str,
+                 resume_checkpoint: Optional[Checkpoint] = None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.experiment_name = experiment_name
+        self.storage_path = storage_path
+        self.resume_checkpoint = resume_checkpoint
+        self.reports: "queue.Queue[dict]" = queue.Queue()
+        self.stop_event = threading.Event()
+        self._report_seq = 0
+
+    # -- user API ----------------------------------------------------------
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        if self.stop_event.is_set():
+            raise RuntimeError("training was asked to stop")
+        self._report_seq += 1
+        entry: dict = {"metrics": dict(metrics), "seq": self._report_seq,
+                       "world_rank": self.world_rank}
+        if checkpoint is not None:
+            # Rank-0 persists by convention (SPMD: identical state everywhere
+            # unless the checkpoint itself is sharded per-rank).
+            entry["checkpoint_dir"] = checkpoint.path
+        self.reports.put(entry)
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.resume_checkpoint
+
+    def drain_reports(self) -> list[dict]:
+        out = []
+        while True:
+            try:
+                out.append(self.reports.get_nowait())
+            except queue.Empty:
+                return out
+
+
+class TrainContext:
+    """What get_context() returns inside a train fn."""
+
+    def __init__(self, session: TrainSession):
+        self._s = session
+
+    def get_world_rank(self) -> int:
+        return self._s.world_rank
+
+    def get_world_size(self) -> int:
+        return self._s.world_size
+
+    def get_local_rank(self) -> int:
+        return self._s.local_rank
+
+    def get_experiment_name(self) -> str:
+        return self._s.experiment_name
+
+    def get_storage_path(self) -> str:
+        return self._s.storage_path
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self._s.get_checkpoint()
+
+
+def _set_session(s: "TrainSession | None"):
+    global _session
+    with _session_lock:
+        _session = s
+
+
+def _get_session() -> Optional[TrainSession]:
+    return _session
+
+
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None):
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.report() called outside a train worker")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("no active train session in this process")
+    return TrainContext(s)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = _get_session()
+    return s.get_checkpoint() if s else None

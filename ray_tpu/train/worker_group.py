@@ -1,0 +1,229 @@
+"""WorkerGroup: gang of train-worker actors pinned to placement-group bundles.
+
+Role-equivalent to the reference's WorkerGroup
+(/root/reference/python/ray/train/v2/_internal/execution/worker_group/
+worker_group.py:104 — PG creation at :269, one actor per bundle at :376-391,
+health barrier) plus the JAX backend's rendezvous
+(v2/jax/config.py:103 `_JaxBackend.on_start`: rank-0 address broadcast then
+``jax.distributed.initialize`` on every worker). On the fake CPU topology the
+distributed init is skipped — collectives run inside the single-process mesh
+(SURVEY §4 fake-TPU testing technique).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import traceback
+from typing import Any, Callable, Optional
+
+import ray_tpu as rt
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.session import TrainSession, _set_session
+
+
+class TrainWorker:
+    """Actor hosting one rank of the SPMD gang; runs the user fn in a thread."""
+
+    def __init__(self, world_rank: int, world_size: int, experiment_name: str,
+                 storage_path: str):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.experiment_name = experiment_name
+        self.storage_path = storage_path
+        self.session: Optional[TrainSession] = None
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[str] = None
+        self.finished = False
+
+    # -- rendezvous --------------------------------------------------------
+    def get_address(self) -> dict:
+        host = socket.gethostname()
+        try:
+            ip = socket.gethostbyname(host)
+        except OSError:
+            ip = "127.0.0.1"
+        # The coordinator port must be free on THIS host (rank 0 binds it);
+        # picking it elsewhere (driver/controller) races other machines.
+        return {"hostname": host, "ip": ip, "pid": os.getpid(),
+                "free_port": _free_port()}
+
+    def setup_distributed(self, coordinator_addr: str, num_processes: int,
+                          process_id: int, use_tpu: bool) -> bool:
+        """jax.distributed bootstrap (reference: _setup_jax_distributed_environment,
+        v2/jax/config.py:30-86). No-op when the gang is a single process or on
+        the fake topology."""
+        os.environ["RAYTPU_COORDINATOR"] = coordinator_addr
+        if use_tpu:
+            os.environ.setdefault("JAX_PLATFORMS", "tpu")
+        if num_processes <= 1 or not use_tpu:
+            return True
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator_addr,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+
+    # -- training lifecycle ------------------------------------------------
+    def start(self, train_fn: Callable, config: dict,
+              resume_checkpoint_path: Optional[str] = None) -> bool:
+        resume = Checkpoint(resume_checkpoint_path) if resume_checkpoint_path else None
+        self.session = TrainSession(
+            world_rank=self.world_rank,
+            world_size=self.world_size,
+            local_rank=0,
+            experiment_name=self.experiment_name,
+            storage_path=self.storage_path,
+            resume_checkpoint=resume,
+        )
+        self.error = None
+        self.finished = False
+
+        def run():
+            _set_session(self.session)
+            try:
+                if _fn_wants_config(train_fn):
+                    train_fn(config)
+                else:
+                    train_fn()
+            except BaseException:  # noqa: BLE001
+                self.error = traceback.format_exc()
+            finally:
+                self.finished = True
+                _set_session(None)
+
+        self.thread = threading.Thread(target=run, name="train_fn", daemon=True)
+        self.thread.start()
+        return True
+
+    def poll(self) -> dict:
+        reports = self.session.drain_reports() if self.session else []
+        return {"reports": reports, "finished": self.finished, "error": self.error}
+
+    def stop(self) -> bool:
+        if self.session:
+            self.session.stop_event.set()
+        return True
+
+
+def _fn_wants_config(fn) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return True
+    return len(sig.parameters) >= 1
+
+
+class WorkerGroup:
+    """Creates the PG + actors; knows how to poll and tear down the gang."""
+
+    def __init__(self, scaling: ScalingConfig, experiment_name: str, storage_path: str):
+        self.scaling = scaling
+        self.experiment_name = experiment_name
+        self.storage_path = storage_path
+        self.pg = None
+        self.reservation = None
+        self.workers: list = []
+
+    def start(self) -> None:
+        n = self.scaling.num_workers
+        res = self.scaling.worker_resources()
+        label_selector: dict = {}
+        if self.scaling.use_tpu and self.scaling.accelerator_type:
+            from ray_tpu.accel.tpu import reserve_tpu_slice
+
+            self.reservation = reserve_tpu_slice(
+                self.scaling.accelerator_type, self.scaling.topology,
+                num_slices=self.scaling.num_slices,
+            )
+            if self.reservation is not None:
+                label_selector.update(self.reservation.label_selector)
+        bundles = [dict(res) for _ in range(n)]
+        self.pg = rt.placement_group(
+            bundles, strategy=self.scaling.placement_strategy,
+            name=f"{self.experiment_name}-gang",
+            label_selector=label_selector,
+        )
+        if not self.pg.ready(timeout=60.0):
+            raise TimeoutError(
+                f"placement group for {n} train workers not schedulable: {bundles}"
+            )
+        worker_cls = rt.remote(TrainWorker)
+        self.workers = [
+            worker_cls.options(
+                placement_group=self.pg,
+                placement_group_bundle_index=i,
+                resources=dict(res),
+                label_selector=dict(label_selector),
+                max_concurrency=4,  # poll/stop must not block behind start()
+            ).remote(i, n, self.experiment_name, self.storage_path)
+            for i in range(n)
+        ]
+        # Health barrier + rendezvous.
+        addrs = rt.get([w.get_address.remote() for w in self.workers], timeout=60)
+        coordinator = f"{addrs[0]['ip']}:{addrs[0]['free_port']}"
+        rt.get(
+            [
+                w.setup_distributed.remote(
+                    coordinator, n, i, self.scaling.use_tpu
+                )
+                for i, w in enumerate(self.workers)
+            ],
+            timeout=120,
+        )
+
+    def run(self, train_fn: Callable, config: dict,
+            resume_checkpoint_path: Optional[str] = None) -> None:
+        rt.get(
+            [
+                w.start.remote(train_fn, config, resume_checkpoint_path)
+                for w in self.workers
+            ],
+            timeout=60,
+        )
+
+    def poll(self) -> list[dict]:
+        # Per-worker gets: a dead rank must not mask the survivors' reports
+        # (rank 0's checkpoints especially — they are the restart point).
+        refs = [w.poll.remote() for w in self.workers]
+        out = []
+        for i, r in enumerate(refs):
+            try:
+                out.append(rt.get(r, timeout=60))
+            except Exception as e:
+                out.append(
+                    {"reports": [], "finished": False,
+                     "error": f"worker {i} died: {e}"}
+                )
+        return out
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                rt.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self.pg is not None:
+            try:
+                rt.remove_placement_group(self.pg)
+            except Exception:
+                pass
+            self.pg = None
+        if self.reservation is not None:
+            self.reservation.release()
+            self.reservation = None
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

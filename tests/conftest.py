@@ -14,6 +14,9 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAYTPU_OBJECT_STORE_MEMORY", str(64 * 1024 * 1024))
+# Spawned workers must also land on CPU (their sitecustomize re-pins the
+# tunneled TPU backend regardless of JAX_PLATFORMS).
+os.environ["RAYTPU_FORCE_JAX_PLATFORM"] = "cpu"
 
 import jax
 

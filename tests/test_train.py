@@ -1,0 +1,281 @@
+"""Train layer tests: gang training, reports, checkpoints, failure recovery.
+
+Multi-node + fake-TPU-topology technique per SURVEY.md §4 (reference:
+test_jax_trainer.py:17-57 fakes v6e-8 slices with env vars + resources).
+"""
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    DataParallelTrainer,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu import train
+
+
+def _simple_fn(config):
+    ctx = train.get_context()
+    for i in range(config["steps"]):
+        train.report({"step": i, "rank": ctx.get_world_rank(), "loss": 1.0 / (i + 1)})
+
+
+def test_data_parallel_trainer_basic(shared_ray, tmp_path):
+    trainer = DataParallelTrainer(
+        _simple_fn,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="basic", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+    assert result.metrics["rank"] == 0  # rank-0 metrics are canonical
+
+
+def _ckpt_fn(config):
+    import tempfile
+
+    ctx = train.get_context()
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            start = json.load(open(os.path.join(d, "state.json")))["step"] + 1
+    for i in range(start, config["steps"]):
+        if config.get("die_at") is not None and i == config["die_at"] and not ckpt:
+            raise RuntimeError("boom")
+        if ctx.get_world_rank() == 0:
+            d = tempfile.mkdtemp()
+            json.dump({"step": i}, open(os.path.join(d, "state.json"), "w"))
+            train.report({"step": i}, checkpoint=Checkpoint.from_directory(d))
+        else:
+            train.report({"step": i})
+
+
+def test_checkpoint_and_gang_restart(shared_ray, tmp_path):
+    """Worker failure -> whole gang restarts and resumes from checkpoint."""
+    trainer = DataParallelTrainer(
+        _ckpt_fn,
+        train_loop_config={"steps": 5, "die_at": 3},
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(
+            name="restart", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    with result.checkpoint.as_directory() as d:
+        assert json.load(open(os.path.join(d, "state.json")))["step"] == 4
+    # resumed from step 3's checkpoint: steps 0,1,2 then 3,4 after restart
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps[-1] == 4 and 3 in steps
+
+
+def test_failure_budget_exhausted(shared_ray, tmp_path):
+    def bad_fn(config):
+        raise ValueError("always fails")
+
+    trainer = DataParallelTrainer(
+        bad_fn,
+        scaling_config=ScalingConfig(num_workers=1, resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(
+            name="fail", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is not None and "always fails" in result.error
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    from ray_tpu.train import CheckpointManager
+
+    mgr = CheckpointManager(
+        str(tmp_path / "runs"), num_to_keep=2,
+        score_attribute="acc", score_order="max",
+    )
+    for i, acc in enumerate([0.1, 0.9, 0.5]):
+        src = tmp_path / f"src{i}"
+        src.mkdir()
+        (src / "x.txt").write_text(str(acc))
+        mgr.register(str(src), {"acc": acc})
+    assert mgr.best.metrics["acc"] == 0.9
+    kept = sorted(p.name for p in (tmp_path / "runs").iterdir() if p.is_dir())
+    assert len(kept) == 2  # 0.1 evicted
+
+
+def test_tpu_slice_gang_scheduling(fresh_cluster):
+    """Fake v4-16 slice: 2 hosts x 4 chips; gang lands on slice hosts only."""
+    from ray_tpu.accel.tpu import (
+        TPU_SLICE_NAME_LABEL,
+        TPU_WORKER_ID_LABEL,
+        reserve_tpu_slice,
+    )
+
+    if rt.is_initialized():
+        rt.shutdown()  # detach from the module-scoped shared cluster
+    cluster = fresh_cluster
+    # worker 0 advertises the slice-head resource (reference tpu.py:224)
+    cluster.add_node(
+        num_cpus=4,
+        resources={"TPU": 4, "TPU-v4-16-head": 1},
+        labels={TPU_SLICE_NAME_LABEL: "slice-a", TPU_WORKER_ID_LABEL: "0"},
+    )
+    cluster.add_node(
+        num_cpus=4,
+        resources={"TPU": 4},
+        labels={TPU_SLICE_NAME_LABEL: "slice-a", TPU_WORKER_ID_LABEL: "1"},
+    )
+    cluster.add_node(num_cpus=4)  # non-TPU node: must NOT get gang workers
+    rt.init(address=cluster.address)
+    try:
+        reservation = reserve_tpu_slice("v4-16")
+        sel = reservation.label_selector
+        assert sel == {TPU_SLICE_NAME_LABEL: "slice-a"}
+
+        @rt.remote
+        class Rank:
+            def where(self):
+                return rt.get_runtime_context().node_id
+
+        # Actors hold TPU chips concurrently -> the gang must span both
+        # slice hosts and never the unlabeled node.
+        actors = [
+            Rank.options(resources={"TPU": 2}, label_selector=sel).remote()
+            for _ in range(4)
+        ]
+        node_ids = set(rt.get([a.where.remote() for a in actors], timeout=30))
+        tpu_nodes = {
+            n["NodeID"] for n in rt.nodes()
+            if n.get("labels", {}).get(TPU_SLICE_NAME_LABEL) == "slice-a"
+        }
+        assert node_ids <= tpu_nodes and len(node_ids) == 2
+    finally:
+        rt.shutdown()
+
+
+def _jax_train_fn(config):
+    """End-to-end: jitted transformer train loop + orbax checkpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import TransformerConfig, make_train_step
+    from ray_tpu.train import Checkpoint, save_pytree, load_pytree
+
+    ctx = train.get_context()
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+        max_seq_len=16, dtype=jnp.float32, attention_impl="reference",
+    )
+    init_state, train_step, _ = make_train_step(cfg)
+    state = init_state(jax.random.PRNGKey(0))
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            meta = json.load(open(os.path.join(d, "meta.json")))
+            start = meta["step"] + 1
+            state = load_pytree(os.path.join(d, "state"), state)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, cfg.vocab_size)
+    step = jax.jit(train_step)
+    for i in range(start, config["steps"]):
+        state, m = step(state, {"tokens": tokens})
+        if ctx.get_world_rank() == 0:
+            import tempfile
+
+            d = tempfile.mkdtemp()
+            save_pytree(state, os.path.join(d, "state"))
+            json.dump({"step": i}, open(os.path.join(d, "meta.json"), "w"))
+            train.report(
+                {"step": i, "loss": float(m["loss"])},
+                checkpoint=Checkpoint.from_directory(d),
+            )
+        else:
+            train.report({"step": i, "loss": float(m["loss"])})
+
+
+def test_jax_trainer_end_to_end(shared_ray, tmp_path):
+    from ray_tpu.train import JaxTrainer
+
+    trainer = JaxTrainer(
+        _jax_train_fn,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(
+            name="jax_e2e", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    losses = [m["loss"] for m in result.metrics_history]
+    assert losses[-1] < losses[0]
+    # top-K retention: only 2 checkpoint dirs remain
+    ckpts = [
+        p for p in os.listdir(str(tmp_path / "jax_e2e"))
+        if p.startswith("checkpoint_") and os.path.isdir(str(tmp_path / "jax_e2e" / p))
+    ]
+    assert len(ckpts) == 2
+    # restored state round-trips through orbax
+    from ray_tpu.train import load_pytree
+
+    restored = load_pytree(os.path.join(result.checkpoint.path, "state"))
+    assert int(restored["step"]) == 3
+
+
+def test_slice_reservation_release_allows_rereserve(fresh_cluster):
+    """Releasing the head PG frees the slice for the next gang (restart path)."""
+    from ray_tpu.accel.tpu import TPU_SLICE_NAME_LABEL, reserve_tpu_slice
+
+    if rt.is_initialized():
+        rt.shutdown()
+    cluster = fresh_cluster
+    cluster.add_node(
+        num_cpus=2, resources={"TPU": 4, "TPU-v4-8-head": 1},
+        labels={TPU_SLICE_NAME_LABEL: "s0"},
+    )
+    rt.init(address=cluster.address)
+    try:
+        r1 = reserve_tpu_slice("v4-8")
+        assert r1.label_selector[TPU_SLICE_NAME_LABEL] == "s0"
+        # Second reservation must block (head consumed) -> release -> succeeds
+        with pytest.raises(TimeoutError):
+            reserve_tpu_slice("v4-8", timeout=0.5)
+        r1.release()
+        r2 = reserve_tpu_slice("v4-8", timeout=10)
+        assert r2.label_selector[TPU_SLICE_NAME_LABEL] == "s0"
+        r2.release()
+    finally:
+        rt.shutdown()
+
+
+def test_pg_label_selector_constrains_bundles(fresh_cluster):
+    if rt.is_initialized():
+        rt.shutdown()
+    cluster = fresh_cluster
+    cluster.add_node(num_cpus=4, labels={"zone": "a"})
+    cluster.add_node(num_cpus=4, labels={"zone": "b"})
+    rt.init(address=cluster.address)
+    try:
+        pg = rt.placement_group(
+            [{"CPU": 1}, {"CPU": 1}], strategy="PACK", label_selector={"zone": "b"}
+        )
+        assert pg.ready(timeout=10)
+        zone_b = {
+            n["NodeID"] for n in rt.nodes() if n.get("labels", {}).get("zone") == "b"
+        }
+        assert set(pg.bundle_nodes()) <= zone_b
+    finally:
+        rt.shutdown()
