@@ -1,0 +1,46 @@
+"""ray_tpu.collective: named collective groups (host control plane).
+
+Role-equivalent to the reference's ray.util.collective
+(/root/reference/python/ray/util/collective/collective.py: init_collective_group
+:171, create_collective_group:211, allreduce:328, barrier:368, reduce:381,
+broadcast:443, allgather:493, reducescatter:542, send:601/recv:664). The
+reference backs these with NCCL/Gloo process groups; on TPU the accelerator
+data plane belongs to XLA — in-program psum/all_gather over the mesh
+(ray_tpu.parallel) — so this module provides the HOST plane: small-tensor /
+object collectives between processes for bootstrap, barriers and metric
+aggregation, rendezvoused through a named coordinator actor exactly like the
+reference's named-actor + KV rendezvous (collective.py:71 GroupManager).
+"""
+from ray_tpu.collective.collective import (
+    CollectiveActorMixin,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+
+__all__ = [
+    "CollectiveActorMixin",
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "create_collective_group",
+    "destroy_collective_group",
+    "get_rank",
+    "get_collective_group_size",
+    "init_collective_group",
+    "recv",
+    "reduce",
+    "reducescatter",
+    "send",
+]

@@ -1,0 +1,265 @@
+"""Host-plane collectives over a named coordinator actor.
+
+Each group is a detached named actor (`raytpu_collective:<name>`) holding
+per-round mailboxes; ranks rendezvous by name (reference: GroupManager +
+named-actor rendezvous, collective.py:71). Ops are synchronous and round-
+numbered per (group, op) so repeated calls pipeline correctly.
+
+Reductions run on numpy (host memory). For device arrays inside a compiled
+program, use the mesh collectives (jax psum / all_gather) — that path never
+touches this module.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+_GROUP_PREFIX = "raytpu_collective:"
+# Process-scoped registry (reference: GroupManager, collective.py:71). Actor
+# methods may run on different pool threads, so thread-local scope would lose
+# the group between calls.
+_process_groups: dict = {}
+
+
+class _GroupCoordinator:
+    """Named actor: mailbox per (op, round). max_concurrency lets all ranks
+    block inside gather() simultaneously."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.rounds: dict[str, dict[int, Any]] = {}
+        self.done: dict[str, Any] = {}
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def contribute(self, key: str, rank: int, value: Any) -> None:
+        box = self.rounds.setdefault(key, {})
+        box[rank] = value
+
+    def poll(self, key: str) -> Optional[dict]:
+        box = self.rounds.get(key)
+        if box is not None and len(box) == self.world_size:
+            self.rounds.pop(key, None)
+            self.done[key] = box
+        return self.done.get(key)
+
+    def fetch(self, key: str) -> Optional[dict]:
+        return self.done.get(key)
+
+    def gc(self, key: str, rank: int) -> None:
+        ack_key = key + ":ack"
+        acks = self.rounds.setdefault(ack_key, {})
+        acks[rank] = True
+        if len(acks) == self.world_size:
+            self.rounds.pop(ack_key, None)
+            self.done.pop(key, None)
+
+    # point-to-point
+    def put_p2p(self, key: str, value: Any) -> None:
+        self.done[key] = {"v": value}
+
+    def take_p2p(self, key: str) -> Optional[dict]:
+        return self.done.pop(key, None)
+
+
+class _GroupHandle:
+    def __init__(self, name: str, actor, world_size: int, rank: int):
+        self.name = name
+        self.actor = actor
+        self.world_size = world_size
+        self.rank = rank
+        self.counters: dict[str, int] = {}
+
+    def next_key(self, op: str) -> str:
+        c = self.counters.get(op, 0)
+        self.counters[op] = c + 1
+        return f"{op}:{c}"
+
+    def exchange(self, op: str, value: Any, timeout: float = 120.0) -> dict:
+        """All ranks contribute; returns {rank: value} for all ranks."""
+        import ray_tpu as rt
+
+        key = self.next_key(op)
+        rt.get(self.actor.contribute.remote(key, self.rank, value), timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while True:
+            box = rt.get(self.actor.poll.remote(key), timeout=timeout)
+            if box is not None:
+                rt.get(self.actor.gc.remote(key, self.rank), timeout=timeout)
+                return box
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"collective {op} timed out in group {self.name}")
+            time.sleep(0.005)
+
+
+def _groups() -> dict:
+    return _process_groups
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default") -> None:
+    """Join (creating if needed) the named group from this process."""
+    import ray_tpu as rt
+
+    if backend not in ("host", "xla"):
+        raise ValueError(f"unknown backend {backend!r}; host (this module) or "
+                         "xla (use mesh collectives inside jit)")
+    name = _GROUP_PREFIX + group_name
+    Coordinator = rt.remote(_GroupCoordinator)
+    try:
+        actor = rt.get_actor(name)
+    except ValueError:
+        try:
+            actor = Coordinator.options(
+                name=name, lifetime="detached", max_concurrency=max(8, world_size * 2)
+            ).remote(world_size)
+        except Exception:
+            actor = rt.get_actor(name)
+    existing = rt.get(actor.get_world_size.remote(), timeout=30)
+    if existing != world_size:
+        raise ValueError(
+            f"collective group {group_name!r} already exists with world_size="
+            f"{existing} (asked for {world_size}); destroy_collective_group() "
+            "the stale group first"
+        )
+    _groups()[group_name] = _GroupHandle(name, actor, world_size, rank)
+
+
+class CollectiveActorMixin:
+    """Inherit in an actor class to make it joinable via
+    create_collective_group (driver-side declarative API)."""
+
+    def raytpu_join_collective(self, world_size: int, rank: int,
+                               backend: str, group_name: str) -> bool:
+        init_collective_group(world_size, rank, backend, group_name)
+        return True
+
+
+def create_collective_group(actors: list, world_size: int, ranks: list[int],
+                            backend: str = "host",
+                            group_name: str = "default") -> None:
+    """Driver-side declaration (reference: create_collective_group:211):
+    tells each actor (a CollectiveActorMixin subclass) to join with its rank."""
+    import ray_tpu as rt
+
+    rt.get([
+        a.raytpu_join_collective.remote(world_size, r, backend, group_name)
+        for a, r in zip(actors, ranks)
+    ], timeout=60)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    import ray_tpu as rt
+
+    g = _groups().pop(group_name, None)
+    if g is not None:
+        try:
+            rt.kill(g.actor)
+        except Exception:
+            pass
+
+
+def _group(group_name: str) -> _GroupHandle:
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this process; "
+            "call init_collective_group(world_size, rank, group_name=...)"
+        )
+    return g
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def _to_np(x):
+    return np.asarray(x)
+
+
+_REDUCERS = {
+    "sum": lambda arrs: sum(arrs[1:], start=arrs[0]),
+    "prod": lambda arrs: np.prod(np.stack(arrs), axis=0),
+    "max": lambda arrs: np.max(np.stack(arrs), axis=0),
+    "min": lambda arrs: np.min(np.stack(arrs), axis=0),
+}
+
+
+def allreduce(tensor, op: str = "sum", group_name: str = "default"):
+    g = _group(group_name)
+    box = g.exchange("allreduce", _to_np(tensor))
+    arrs = [box[r] for r in sorted(box)]
+    return _REDUCERS[op](arrs)
+
+
+def reduce(tensor, dst_rank: int = 0, op: str = "sum", group_name: str = "default"):
+    g = _group(group_name)
+    box = g.exchange("reduce", _to_np(tensor))
+    if g.rank != dst_rank:
+        return None
+    arrs = [box[r] for r in sorted(box)]
+    return _REDUCERS[op](arrs)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _group(group_name)
+    payload = _to_np(tensor) if g.rank == src_rank else None
+    box = g.exchange("broadcast", payload)
+    return box[src_rank]
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    g = _group(group_name)
+    box = g.exchange("allgather", _to_np(tensor))
+    return [box[r] for r in sorted(box)]
+
+
+def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
+    """Each rank contributes a [world, ...] stack; rank r gets the reduction
+    of everyone's r-th shard."""
+    g = _group(group_name)
+    t = _to_np(tensor)
+    if t.shape[0] != g.world_size:
+        raise ValueError(
+            f"reducescatter input leading dim {t.shape[0]} != world {g.world_size}"
+        )
+    box = g.exchange("reducescatter", t)
+    arrs = [box[r][g.rank] for r in sorted(box)]
+    return _REDUCERS[op](arrs)
+
+
+def barrier(group_name: str = "default") -> None:
+    _group(group_name).exchange("barrier", None)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    import ray_tpu as rt
+
+    g = _group(group_name)
+    chan = f"p2p:{g.rank}->{dst_rank}"
+    key = f"{chan}:{g.next_key(chan)}"
+    rt.get(g.actor.put_p2p.remote(key, _to_np(tensor)), timeout=60)
+
+
+def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
+    import ray_tpu as rt
+
+    g = _group(group_name)
+    chan = f"p2p:{src_rank}->{g.rank}"
+    key = f"{chan}:{g.next_key(chan)}"
+    deadline = time.monotonic() + timeout
+    while True:
+        got = rt.get(g.actor.take_p2p.remote(key), timeout=timeout)
+        if got is not None:
+            return got["v"]
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"recv from {src_rank} timed out")
+        time.sleep(0.005)

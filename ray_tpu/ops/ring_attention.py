@@ -84,7 +84,8 @@ def ring_attention(
     when the axis is absent or trivial.
     """
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+
+    from ray_tpu.parallel._shard_map import shard_map
 
     from ray_tpu.ops.attention import mha_reference
     from ray_tpu.parallel.sharding import _ambient_mesh
@@ -103,5 +104,5 @@ def ring_attention(
         _ring_body, axis_name=axis_name, causal=causal, scale=scale, n_ring=n_ring
     )
     return shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

@@ -1,0 +1,22 @@
+"""Version-tolerant shard_map import (jax.shard_map vs experimental)."""
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map as _sm  # jax >= 0.8
+
+        try:
+            return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_vma=False)
+        except TypeError:
+            try:  # check_rep-era top-level API
+                return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                           check_rep=False)
+            except TypeError:
+                return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
