@@ -1,0 +1,99 @@
+"""Blocks: the unit of data flow — pyarrow Tables in the object store.
+
+Role-equivalent to the reference's block model (ray.data blocks are Arrow
+tables in plasma; SURVEY.md §2.4 Data row). Batch formats mirror the
+reference's map_batches contract: "numpy" (dict of ndarrays), "pandas",
+"pyarrow", or "rows" (list of dicts).
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+
+def block_from_rows(rows: list) -> Block:
+    """Rows: dicts -> columnar table; scalars -> single 'item' column."""
+    if not rows:
+        return pa.table({})
+    if isinstance(rows[0], dict):
+        cols: dict[str, list] = {k: [] for k in rows[0]}
+        for r in rows:
+            for k in cols:
+                cols[k].append(r.get(k))
+        return pa.table({k: _to_array(v) for k, v in cols.items()})
+    return pa.table({"item": _to_array(list(rows))})
+
+
+def _to_array(values: list) -> pa.Array:
+    if values and isinstance(values[0], np.ndarray):
+        # Tensor column: fixed-shape ndarrays stored as lists (reference uses
+        # an ArrowTensorArray extension; plain lists keep us dependency-lean).
+        return pa.array([v.tolist() for v in values])
+    return pa.array(values)
+
+
+def block_from_batch(batch: Any) -> Block:
+    """Accept whatever a map_batches UDF returned."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        return pa.table({k: _to_array(list(v) if isinstance(v, np.ndarray) else v)
+                         for k, v in batch.items()})
+    if _is_pandas(batch):
+        return pa.Table.from_pandas(batch, preserve_index=False)
+    if isinstance(batch, list):
+        return block_from_rows(batch)
+    raise TypeError(f"unsupported batch type {type(batch)}")
+
+
+def _is_pandas(x) -> bool:
+    try:
+        import pandas as pd
+
+        return isinstance(x, pd.DataFrame)
+    except ImportError:
+        return False
+
+
+def block_to_batch(block: Block, batch_format: str = "numpy") -> Any:
+    if batch_format in ("pyarrow", "arrow"):
+        return block
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format == "numpy":
+        return {name: np.asarray(col.to_pylist()) for name, col in
+                zip(block.column_names, block.columns)}
+    if batch_format in ("rows", "default"):
+        return block.to_pylist()
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def block_rows(block: Block) -> list[dict]:
+    return block.to_pylist()
+
+
+def block_num_rows(block: Block) -> int:
+    return block.num_rows
+
+
+def block_slice(block: Block, start: int, end: int) -> Block:
+    return block.slice(start, end - start)
+
+
+def block_take(block: Block, indices: "np.ndarray") -> Block:
+    return block.take(pa.array(indices))
+
+
+def concat_blocks(blocks: Iterable[Block]) -> Block:
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def block_size_bytes(block: Block) -> int:
+    return block.nbytes

@@ -1,0 +1,258 @@
+"""Streaming executor: pulls blocks through fused operator segments.
+
+Role-equivalent to the reference's StreamingExecutor
+(/root/reference/python/ray/data/_internal/execution/streaming_executor.py:71
+— "routes blocks through operators maximizing throughput under resource
+constraints"). Same core ideas, sized to this runtime:
+
+- blocks are ObjectRefs to Arrow tables; the driver never holds data, only
+  refs (data stays in the shared-memory store);
+- one-to-one op chains are FUSED into a single remote task per block
+  (reference: fusion rules in logical/ruleset.py);
+- bounded in-flight task budget = backpressure (reference:
+  backpressure_policy/);
+- all-to-all ops (repartition, shuffle, sort, groupby) are barrier stages
+  (reference: hash_shuffle.py) built from the same task primitives.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+from ray_tpu.data.logical import LogicalOp
+
+DEFAULT_MAX_IN_FLIGHT = 8
+
+
+# ---------------------------------------------------------------------------
+# Fused segment application (runs inside worker tasks)
+# ---------------------------------------------------------------------------
+
+def _apply_segment(blk, ops: list[tuple[str, Callable, dict]]):
+    for kind, fn, params in ops:
+        if blk.num_rows == 0 and kind != "map_batches":
+            continue
+        if kind == "map_batches":
+            fmt = params.get("batch_format", "numpy")
+            out = fn(B.block_to_batch(blk, fmt))
+            blk = B.block_from_batch(out)
+        elif kind == "map":
+            blk = B.block_from_rows([fn(r) for r in B.block_rows(blk)])
+        elif kind == "filter":
+            blk = B.block_from_rows([r for r in B.block_rows(blk) if fn(r)])
+        elif kind == "flat_map":
+            out = []
+            for r in B.block_rows(blk):
+                out.extend(fn(r))
+            blk = B.block_from_rows(out)
+        else:
+            raise ValueError(f"not a one-to-one op: {kind}")
+    return blk
+
+
+def _read_fn_task(read_fn: Callable):
+    return read_fn()
+
+
+class StreamingExecutor:
+    def __init__(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT):
+        self.max_in_flight = max_in_flight
+
+    # -- public ------------------------------------------------------------
+    def execute(self, plan_leaf: LogicalOp) -> Iterator:
+        """Yields ObjectRefs of output blocks, streaming."""
+        chain = plan_leaf.chain_from_source()
+        return self._run_chain(chain)
+
+    # -- internals ---------------------------------------------------------
+    def _run_chain(self, chain: list[LogicalOp]) -> Iterator:
+        src, rest = chain[0], chain[1:]
+        stream = self._source_stream(src)
+        seg: list[LogicalOp] = []
+        for op in rest:
+            if op.is_one_to_one:
+                seg.append(op)
+                continue
+            stream = self._mapped_stream(stream, seg)
+            seg = []
+            stream = self._all_to_all(stream, op)
+        return self._mapped_stream(stream, seg)
+
+    def _source_stream(self, src: LogicalOp) -> Iterator:
+        import ray_tpu as rt
+
+        if src.kind == "source":
+            if "block_refs" in src.params:
+                yield from src.params["block_refs"]
+                return
+            read_task = rt.remote(_read_fn_task)
+            pending = []
+            for read_fn in src.params["read_fns"]:
+                pending.append(read_task.remote(read_fn))
+                while len(pending) >= self.max_in_flight:
+                    yield pending.pop(0)
+            yield from pending
+        elif src.kind == "union":
+            for parent in src.inputs:
+                yield from self._run_chain(parent.chain_from_source())
+        else:
+            raise ValueError(f"unknown source kind {src.kind}")
+
+    def _mapped_stream(self, stream: Iterator, seg: list[LogicalOp]) -> Iterator:
+        if not seg:
+            yield from stream
+            return
+        import ray_tpu as rt
+
+        ops = [(o.kind, o.fn, o.params) for o in seg]
+        task = rt.remote(_apply_segment)
+        pending: list = []
+        for ref in stream:
+            pending.append(task.remote(ref, ops))
+            while len(pending) >= self.max_in_flight:
+                yield pending.pop(0)
+        yield from pending
+
+    # -- all-to-all stages -------------------------------------------------
+    def _all_to_all(self, stream: Iterator, op: LogicalOp) -> Iterator:
+        import ray_tpu as rt
+
+        refs = list(stream)  # barrier
+        if op.kind == "limit":
+            yield from self._limit(refs, op.params["n"])
+            return
+        if not refs:
+            return
+        if op.kind == "repartition":
+            yield from self._repartition(refs, op.params["num_blocks"])
+        elif op.kind == "random_shuffle":
+            yield from self._random_shuffle(refs, op.params.get("seed"))
+        elif op.kind == "sort":
+            yield from self._sort(refs, op.params["key"], op.params.get("descending", False))
+        elif op.kind == "groupby_map":
+            yield from self._groupby(refs, op.params["key"], op.fn)
+        else:
+            raise ValueError(f"unknown all-to-all op {op.kind}")
+
+    def _limit(self, refs: list, n: int) -> Iterator:
+        import ray_tpu as rt
+
+        remaining = n
+        slice_task = rt.remote(lambda blk, k: B.block_slice(blk, 0, k))
+        counts = rt.get([_num_rows_task().remote(r) for r in refs])
+        for ref, cnt in zip(refs, counts):
+            if remaining <= 0:
+                return
+            if cnt <= remaining:
+                yield ref
+                remaining -= cnt
+            else:
+                yield slice_task.remote(ref, remaining)
+                remaining = 0
+
+    def _repartition(self, refs: list, num_blocks: int) -> Iterator:
+        import ray_tpu as rt
+
+        build = rt.remote(_build_partition)
+        counts = rt.get([_num_rows_task().remote(r) for r in refs])
+        total = sum(counts)
+        per = max(1, total // max(1, num_blocks))
+        bounds = [min(i * per, total) for i in range(num_blocks)] + [total]
+        for i in range(num_blocks):
+            yield build.remote(bounds[i], bounds[i + 1], counts, *refs)
+
+    def _random_shuffle(self, refs: list, seed) -> Iterator:
+        import ray_tpu as rt
+
+        counts = rt.get([_num_rows_task().remote(r) for r in refs])
+        total = sum(counts)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(total)
+        n_out = len(refs)
+        per = max(1, (total + n_out - 1) // n_out)
+        build = rt.remote(_take_global)
+        for i in range(n_out):
+            idxs = perm[i * per: (i + 1) * per]
+            if len(idxs):
+                yield build.remote(idxs, counts, *refs)
+
+    def _sort(self, refs: list, key: str, descending: bool) -> Iterator:
+        import ray_tpu as rt
+
+        merged = rt.remote(_sort_all).remote(key, descending, *refs)
+        yield merged
+
+    def _groupby(self, refs: list, key: str, agg_fn: Callable) -> Iterator:
+        import ray_tpu as rt
+
+        yield rt.remote(_groupby_all).remote(key, agg_fn, *refs)
+
+
+_num_rows_remote = None
+
+
+def _num_rows_task():
+    global _num_rows_remote
+    if _num_rows_remote is None:
+        import ray_tpu as rt
+
+        _num_rows_remote = rt.remote(B.block_num_rows)
+    return _num_rows_remote
+
+
+# -- remote helpers (top-level so they pickle by reference cheaply) ---------
+
+def _build_partition(start: int, end: int, counts: list[int], *blocks):
+    """Rows [start, end) of the concatenated stream."""
+    out = []
+    offset = 0
+    for cnt, blk in zip(counts, blocks):
+        lo, hi = max(start, offset), min(end, offset + cnt)
+        if lo < hi:
+            out.append(B.block_slice(blk, lo - offset, hi - offset))
+        offset += cnt
+    return B.concat_blocks(out)
+
+
+def _take_global(indices: "np.ndarray", counts: list[int], *blocks):
+    """Select global row indices across the block list."""
+    offsets = np.cumsum([0] + list(counts))
+    parts = []
+    order = np.argsort(indices, kind="stable")
+    sorted_idx = np.asarray(indices)[order]
+    pos = 0
+    for i, blk in enumerate(blocks):
+        lo, hi = offsets[i], offsets[i + 1]
+        sel = sorted_idx[(sorted_idx >= lo) & (sorted_idx < hi)] - lo
+        if len(sel):
+            parts.append(B.block_take(blk, sel))
+        pos += len(sel)
+    merged = B.concat_blocks(parts)
+    # restore requested order
+    inverse = np.empty(len(order), dtype=np.int64)
+    inverse[order] = np.arange(len(order))
+    return B.block_take(merged, inverse)
+
+
+def _sort_all(key: str, descending: bool, *blocks):
+    merged = B.concat_blocks(list(blocks))
+    if merged.num_rows == 0:
+        return merged
+    col = np.asarray(merged.column(key).to_pylist())
+    order = np.argsort(col, kind="stable")
+    if descending:
+        order = order[::-1]
+    return B.block_take(merged, order)
+
+
+def _groupby_all(key: str, agg_fn, *blocks):
+    merged = B.concat_blocks(list(blocks))
+    rows = B.block_rows(merged)
+    groups: dict = {}
+    for r in rows:
+        groups.setdefault(r[key], []).append(r)
+    out = [agg_fn(k, v) for k, v in groups.items()]
+    return B.block_from_rows(out)
