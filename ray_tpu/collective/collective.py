@@ -31,9 +31,30 @@ class _GroupCoordinator:
         self.world_size = world_size
         self.rounds: dict[str, dict[int, Any]] = {}
         self.done: dict[str, Any] = {}
+        # Gang incarnation: an epoch is assigned only when world_size DISTINCT
+        # ranks have entered the lobby (full-gang rendezvous), so all members
+        # of a gang always agree on it and a restarted gang never reads
+        # mailboxes left over from a dead one. A re-joining rank replaces its
+        # stale lobby entry (the old process is presumed dead).
+        self.epoch = 0
+        self._lobby: dict[int, str] = {}  # rank -> join id
+        self._assigned: dict[str, int] = {}  # join id -> epoch
 
     def get_world_size(self) -> int:
         return self.world_size
+
+    def join_begin(self, rank: int, join_id: str) -> None:
+        self._lobby[rank] = join_id
+        if len(self._lobby) == self.world_size:
+            self.epoch += 1
+            for jid in self._lobby.values():
+                self._assigned[jid] = self.epoch
+            self._lobby.clear()
+            self.rounds.clear()
+            self.done.clear()
+
+    def join_epoch(self, join_id: str) -> Optional[int]:
+        return self._assigned.get(join_id)
 
     def contribute(self, key: str, rank: int, value: Any) -> None:
         box = self.rounds.setdefault(key, {})
@@ -66,17 +87,42 @@ class _GroupCoordinator:
 
 
 class _GroupHandle:
-    def __init__(self, name: str, actor, world_size: int, rank: int):
+    def __init__(self, name: str, actor, world_size: int, rank: int, join_id: str):
         self.name = name
         self.actor = actor
         self.world_size = world_size
         self.rank = rank
+        self.join_id = join_id
+        self.epoch: Optional[int] = None  # resolved at first collective
         self.counters: dict[str, int] = {}
 
+    def ensure_epoch(self, timeout: float = 120.0) -> int:
+        """Block until the whole gang has joined and an epoch is assigned.
+
+        Deferred to the first collective op (init stays non-blocking, like
+        the reference where NCCL rendezvous happens lazily)."""
+        import ray_tpu as rt
+
+        if self.epoch is not None:
+            return self.epoch
+        deadline = time.monotonic() + timeout
+        while True:
+            epoch = rt.get(self.actor.join_epoch.remote(self.join_id), timeout=timeout)
+            if epoch is not None:
+                self.epoch = epoch
+                return epoch
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"group {self.name}: gang never fully joined "
+                    f"(world_size={self.world_size})"
+                )
+            time.sleep(0.005)
+
     def next_key(self, op: str) -> str:
+        epoch = self.ensure_epoch()
         c = self.counters.get(op, 0)
         self.counters[op] = c + 1
-        return f"{op}:{c}"
+        return f"e{epoch}:{op}:{c}"
 
     def exchange(self, op: str, value: Any, timeout: float = 120.0) -> dict:
         """All ranks contribute; returns {rank: value} for all ranks."""
@@ -126,7 +172,11 @@ def init_collective_group(world_size: int, rank: int,
             f"{existing} (asked for {world_size}); destroy_collective_group() "
             "the stale group first"
         )
-    _groups()[group_name] = _GroupHandle(name, actor, world_size, rank)
+    import uuid
+
+    join_id = uuid.uuid4().hex
+    rt.get(actor.join_begin.remote(rank, join_id), timeout=30)
+    _groups()[group_name] = _GroupHandle(name, actor, world_size, rank, join_id)
 
 
 class CollectiveActorMixin:
@@ -157,10 +207,19 @@ def destroy_collective_group(group_name: str = "default") -> None:
 
     g = _groups().pop(group_name, None)
     if g is not None:
+        actor = g.actor
+    else:
+        # Caller (e.g. the driver after create_collective_group) never joined
+        # locally — the detached coordinator still must die, or re-creating
+        # the group with a different world_size stays blocked forever.
         try:
-            rt.kill(g.actor)
-        except Exception:
-            pass
+            actor = rt.get_actor(_GROUP_PREFIX + group_name)
+        except ValueError:
+            return
+    try:
+        rt.kill(actor)
+    except Exception:
+        pass
 
 
 def _group(group_name: str) -> _GroupHandle:

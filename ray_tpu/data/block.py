@@ -65,11 +65,33 @@ def block_to_batch(block: Block, batch_format: str = "numpy") -> Any:
     if batch_format == "pandas":
         return block.to_pandas()
     if batch_format == "numpy":
-        return {name: np.asarray(col.to_pylist()) for name, col in
+        return {name: _col_to_numpy(col) for name, col in
                 zip(block.column_names, block.columns)}
     if batch_format in ("rows", "default"):
         return block.to_pylist()
     raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def _col_to_numpy(col: "pa.ChunkedArray") -> np.ndarray:
+    """Dtype-preserving column -> ndarray (no per-value Python boxing).
+
+    Fixed-size tensor columns (lists of equal-length lists) come back as a
+    stacked [rows, ...] ndarray rather than an object array.
+    """
+    col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    typ = col.type
+    if pa.types.is_list(typ) or pa.types.is_large_list(typ) or pa.types.is_fixed_size_list(typ):
+        values = col.to_pylist()
+        try:
+            return np.asarray(values)  # ragged -> ValueError / object array
+        except ValueError:
+            out = np.empty(len(values), dtype=object)
+            out[:] = values
+            return out
+    try:
+        return col.to_numpy(zero_copy_only=False)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        return np.asarray(col.to_pylist())
 
 
 def block_rows(block: Block) -> list[dict]:

@@ -77,6 +77,9 @@ class CheckpointManager:
         # list of (score, index, Checkpoint); score None -> recency ordering
         self._checkpoints: list[tuple[Any, int, Checkpoint]] = []
         os.makedirs(storage_path, exist_ok=True)
+        # Orphaned worker-side staging dirs (reports whose worker died before
+        # the controller absorbed them) are garbage from a previous run.
+        shutil.rmtree(os.path.join(storage_path, ".staging"), ignore_errors=True)
         self._load_state()
 
     # -- persistence of the manager's own state (controller restart) -------
@@ -111,11 +114,25 @@ class CheckpointManager:
 
     # -- registration ------------------------------------------------------
     def register(self, src_dir: str, metrics: dict) -> Checkpoint:
-        """Copy a worker-produced checkpoint dir into managed storage."""
+        """Adopt a worker-persisted checkpoint dir into managed storage.
+
+        Workers persist into ``storage_path/.staging/`` (session._persist);
+        those are renamed into place. Paths outside storage are copied.
+        """
         self._index += 1
         dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
-        if os.path.abspath(src_dir) != dest:
-            shutil.copytree(src_dir, dest, dirs_exist_ok=True)
+        # A pre-existing dest means the index counter reset (e.g. lost
+        # manager state after a crash) — never clobber, skip past it.
+        while os.path.exists(dest):
+            self._index += 1
+            dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
+        src = os.path.abspath(src_dir)
+        if src != dest:
+            staging_root = os.path.join(os.path.abspath(self.storage_path), ".staging")
+            if src.startswith(staging_root + os.sep) and os.path.isdir(src):
+                os.replace(src, dest)
+            else:
+                shutil.copytree(src, dest, dirs_exist_ok=True)
         ckpt = Checkpoint(dest, dict(metrics))
         score = metrics.get(self.score_attribute) if self.score_attribute else None
         self._checkpoints.append((score, self._index, ckpt))

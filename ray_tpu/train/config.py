@@ -30,7 +30,13 @@ class ScalingConfig:
             from ray_tpu.accel import tpu as tpu_mod
 
             res["TPU"] = float(tpu_mod.get_chips_per_host(self.accelerator_type))
-        if not res and not self.use_tpu:
+        if not res:
+            if self.use_tpu:
+                raise ValueError(
+                    "ScalingConfig(use_tpu=True) needs accelerator_type "
+                    "(e.g. 'v5p-16') or explicit resources_per_worker; "
+                    "otherwise worker bundles would be empty"
+                )
             res = {"CPU": 1.0}
         return res
 

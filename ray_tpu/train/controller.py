@@ -154,12 +154,25 @@ class TrainController:
                 if rep["world_rank"] == 0:
                     ent["metrics"] = rep["metrics"]
                 if rep.get("checkpoint_dir"):
-                    ent["ckpt"] = (rep["checkpoint_dir"], rep["metrics"])
+                    if ent["ckpt"] and ent["ckpt"][0] != rep["checkpoint_dir"]:
+                        # Several ranks persisted the same seq (SPMD: identical
+                        # state); keep one, drop the duplicates' staging dirs.
+                        import shutil
+
+                        shutil.rmtree(rep["checkpoint_dir"], ignore_errors=True)
+                    else:
+                        ent["ckpt"] = (rep["checkpoint_dir"], rep["metrics"])
         for seq in sorted(by_seq):
             ent = by_seq[seq]
             metrics = ent["metrics"] or (ent["ckpt"][1] if ent["ckpt"] else {})
             if ent["ckpt"]:
-                self.ckpt_manager.register(ent["ckpt"][0], metrics)
+                # A lost/corrupt checkpoint dir must not kill the run: the
+                # metrics are still valid, and training continues from the
+                # previous registered checkpoint.
+                try:
+                    self.ckpt_manager.register(ent["ckpt"][0], metrics)
+                except OSError:
+                    traceback.print_exc()
             if metrics:
                 self.metrics_history.append(metrics)
                 self.latest_metrics = metrics

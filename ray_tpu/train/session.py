@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import os
 import queue
+import shutil
 import threading
+import uuid
 from typing import Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -43,9 +45,27 @@ class TrainSession:
                        "world_rank": self.world_rank}
         if checkpoint is not None:
             # Rank-0 persists by convention (SPMD: identical state everywhere
-            # unless the checkpoint itself is sharded per-rank).
-            entry["checkpoint_dir"] = checkpoint.path
+            # unless the checkpoint itself is sharded per-rank). Persistence
+            # happens HERE, worker-side, into storage_path — the controller
+            # may live on another host and cannot see this worker's local
+            # tempdir (reference: context.py:268 persists inside report()).
+            entry["checkpoint_dir"] = self._persist(checkpoint)
         self.reports.put(entry)
+
+    def _persist(self, checkpoint: Checkpoint) -> str:
+        """Copy a node-local checkpoint dir into shared storage; returns the
+        persisted path (a staging dir the CheckpointManager later adopts)."""
+        src = os.path.abspath(checkpoint.path)
+        storage = os.path.abspath(self.storage_path)
+        if src.startswith(storage + os.sep):
+            return src  # already under managed storage
+        dest = os.path.join(
+            storage, ".staging",
+            f"ckpt-r{self.world_rank}-s{self._report_seq}-{uuid.uuid4().hex[:8]}",
+        )
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        shutil.copytree(src, dest)
+        return dest
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.resume_checkpoint

@@ -120,3 +120,36 @@ def test_world_size_mismatch_raises(shared_ray):
     with pytest.raises(ValueError, match="world_size"):
         col.init_collective_group(2, 0, group_name="ws")
     col.destroy_collective_group("ws")
+
+
+def test_gang_restart_gets_fresh_epoch(shared_ray):
+    """A restarted gang (same name, same world) must not read mailboxes of a
+    dead gang that died mid-collective."""
+    from ray_tpu import collective as col
+
+    @rt.remote
+    class Member(col.CollectiveActorMixin):
+        def half_collective(self, rank):
+            # Join and contribute to allreduce round 0, but never complete it
+            # (simulates a gang dying mid-collective).
+            g = col.collective._group("gr")
+            g.actor.contribute.remote(f"e{g.ensure_epoch()}:allreduce:0", rank,
+                                      np.array([99.0]))
+            return True
+
+        def full_collective(self):
+            return col.allreduce(np.array([1.0]), group_name="gr").tolist()
+
+    gang1 = [Member.options(max_concurrency=2).remote() for _ in range(2)]
+    col.create_collective_group(gang1, 2, [0, 1], group_name="gr")
+    rt.get([m.half_collective.remote(i) for i, m in enumerate(gang1)], timeout=60)
+    for m in gang1:
+        rt.kill(m)
+
+    gang2 = [Member.options(max_concurrency=2).remote() for _ in range(2)]
+    col.create_collective_group(gang2, 2, [0, 1], group_name="gr")
+    outs = rt.get([m.full_collective.remote() for m in gang2], timeout=60)
+    # With stale epoch-1 mailboxes the dead gang's 99s would leak in; the
+    # fresh epoch must yield exactly 1+1.
+    assert outs == [[2.0], [2.0]]
+    col.destroy_collective_group("gr")
