@@ -47,11 +47,13 @@ class _GroupCoordinator:
         self._lobby[rank] = join_id
         if len(self._lobby) == self.world_size:
             self.epoch += 1
+            # Clear mailboxes BEFORE publishing the epoch: once a rank can
+            # observe it, its contributions must never be wiped.
+            self.rounds.clear()
+            self.done.clear()
             for jid in self._lobby.values():
                 self._assigned[jid] = self.epoch
             self._lobby.clear()
-            self.rounds.clear()
-            self.done.clear()
 
     def join_epoch(self, join_id: str) -> Optional[int]:
         return self._assigned.get(join_id)

@@ -1,0 +1,47 @@
+"""ray_tpu.data: lazy, streaming datasets over the shared-memory object store.
+
+Reference surface: ray.data (python/ray/data/__init__.py) — Dataset +
+read_* constructors + from_* converters; execution is streaming
+(StreamingExecutor) with blocks as Arrow tables in the object store.
+"""
+from ray_tpu.data.dataset import (
+    DataIterator,
+    Dataset,
+    GroupedData,
+    batches_from_blocks,
+    from_arrow,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data.infeed import prefetch_to_device
+
+__all__ = [
+    "DataIterator",
+    "Dataset",
+    "GroupedData",
+    "batches_from_blocks",
+    "from_arrow",
+    "from_blocks",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+    "prefetch_to_device",
+]

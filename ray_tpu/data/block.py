@@ -80,7 +80,21 @@ def _col_to_numpy(col: "pa.ChunkedArray") -> np.ndarray:
     """
     col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
     typ = col.type
-    if pa.types.is_list(typ) or pa.types.is_large_list(typ) or pa.types.is_fixed_size_list(typ):
+    if pa.types.is_fixed_size_list(typ):
+        # flatten() respects slice offsets; .values would not.
+        flat = col.flatten().to_numpy(zero_copy_only=False)
+        return flat.reshape(len(col), typ.list_size)
+    if pa.types.is_list(typ) or pa.types.is_large_list(typ):
+        # Uniform-length list columns (tensor columns) reshape without
+        # boxing; ragged ones fall back to an object array.
+        offsets = col.offsets.to_numpy(zero_copy_only=False)
+        widths = np.diff(offsets)
+        if len(col) and col.null_count == 0 and (widths == widths[0]).all():
+            try:
+                flat = col.flatten().to_numpy(zero_copy_only=False)
+                return flat.reshape(len(col), int(widths[0]))
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                pass
         values = col.to_pylist()
         try:
             return np.asarray(values)  # ragged -> ValueError / object array

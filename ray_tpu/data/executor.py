@@ -17,6 +17,7 @@ constraints"). Same core ideas, sized to this runtime:
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Any, Callable, Iterator, Optional
 
 import numpy as np
@@ -78,7 +79,16 @@ class StreamingExecutor:
                 continue
             stream = self._mapped_stream(stream, seg)
             seg = []
-            stream = self._all_to_all(stream, op)
+            if op.kind == "union":
+                # inputs[0] is the upstream chain already in `stream`; the
+                # remaining inputs stream after it.
+                stream = itertools.chain(
+                    stream,
+                    *(self._run_chain(p.chain_from_source())
+                      for p in op.inputs[1:]),
+                )
+            else:
+                stream = self._all_to_all(stream, op)
         return self._mapped_stream(stream, seg)
 
     def _source_stream(self, src: LogicalOp) -> Iterator:
@@ -95,9 +105,6 @@ class StreamingExecutor:
                 while len(pending) >= self.max_in_flight:
                     yield pending.pop(0)
             yield from pending
-        elif src.kind == "union":
-            for parent in src.inputs:
-                yield from self._run_chain(parent.chain_from_source())
         else:
             raise ValueError(f"unknown source kind {src.kind}")
 
@@ -254,5 +261,12 @@ def _groupby_all(key: str, agg_fn, *blocks):
     groups: dict = {}
     for r in rows:
         groups.setdefault(r[key], []).append(r)
-    out = [agg_fn(k, v) for k, v in groups.items()]
+    out: list = []
+    for k, v in groups.items():
+        res = agg_fn(k, v)
+        # map_groups UDFs may emit one row or several per group.
+        if isinstance(res, list):
+            out.extend(res)
+        else:
+            out.append(res)
     return B.block_from_rows(out)

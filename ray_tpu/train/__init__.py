@@ -7,7 +7,13 @@ report()/get_context()/get_checkpoint() from inside the train fn.
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
 from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from ray_tpu.train.controller import Result, TrainController
-from ray_tpu.train.session import TrainContext, get_checkpoint, get_context, report
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
 
@@ -27,6 +33,7 @@ __all__ = [
     "WorkerGroup",
     "get_checkpoint",
     "get_context",
+    "get_dataset_shard",
     "load_pytree",
     "report",
     "save_pytree",

@@ -15,6 +15,7 @@ driver-side blocking wrapper.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 import traceback
 from typing import Any, Callable, Optional
@@ -44,11 +45,13 @@ class TrainController:
 
     def __init__(self, train_fn: Callable, train_config: dict,
                  scaling: ScalingConfig, run_config: RunConfig,
-                 poll_interval_s: float = 0.2, settle_period_s: float = 5.0):
+                 poll_interval_s: float = 0.2, settle_period_s: float = 5.0,
+                 datasets: Optional[dict] = None):
         self.train_fn = train_fn
         self.train_config = train_config
         self.scaling = scaling
         self.run_config = run_config
+        self.datasets = datasets or {}
         self.poll_interval_s = poll_interval_s
         self.settle_period_s = settle_period_s
         self.storage_path = run_config.resolved_storage_path()
@@ -63,6 +66,10 @@ class TrainController:
         self.failures = 0
         self.metrics_history: list[dict] = []
         self.latest_metrics: dict = {}
+        # Seqs absorbed from the CURRENT gang (reset per restart: a restarted
+        # gang re-reports from seq 1 and that re-done work is real).
+        self._seen_ckpt_seqs: set[int] = set()
+        self._seen_metric_seqs: set[int] = set()
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> Result:
@@ -73,6 +80,8 @@ class TrainController:
         while True:
             try:
                 if group is None:
+                    self._seen_ckpt_seqs.clear()
+                    self._seen_metric_seqs.clear()
                     group = WorkerGroup(self.scaling, name, self.storage_path)
                     group.start()
                     resume = self.ckpt_manager.latest
@@ -80,6 +89,7 @@ class TrainController:
                         self.train_fn,
                         self.train_config,
                         resume.path if resume else None,
+                        datasets=self.datasets,
                     )
                     self.state = "RUNNING"
                 status = group.poll()
@@ -144,9 +154,21 @@ class TrainController:
             metrics_history=self.metrics_history,
         )
 
+    def _drop_staged(self, path: str) -> None:
+        """Remove a duplicate checkpoint dir — but ONLY if it is a staging
+        dir this controller owns; per-rank sharded checkpoint dirs elsewhere
+        under storage_path are user data."""
+        import shutil
+
+        staging = os.path.join(os.path.abspath(self.storage_path), ".staging")
+        if os.path.abspath(path).startswith(staging + os.sep):
+            shutil.rmtree(path, ignore_errors=True)
+
     def _absorb_reports(self, status: list[dict]) -> None:
         # Group per-worker reports by seq; rank 0's metrics are canonical
         # (SPMD), checkpoints may come from any rank (rank 0 by convention).
+        # _seen_*_seqs dedupe across poll cycles: the same seq can arrive
+        # from different ranks in different polls.
         by_seq: dict[int, dict] = {}
         for st in status:
             for rep in st["reports"]:
@@ -154,18 +176,21 @@ class TrainController:
                 if rep["world_rank"] == 0:
                     ent["metrics"] = rep["metrics"]
                 if rep.get("checkpoint_dir"):
-                    if ent["ckpt"] and ent["ckpt"][0] != rep["checkpoint_dir"]:
+                    already = (
+                        rep["seq"] in self._seen_ckpt_seqs
+                        or (ent["ckpt"] and ent["ckpt"][0] != rep["checkpoint_dir"])
+                    )
+                    if already:
                         # Several ranks persisted the same seq (SPMD: identical
-                        # state); keep one, drop the duplicates' staging dirs.
-                        import shutil
-
-                        shutil.rmtree(rep["checkpoint_dir"], ignore_errors=True)
+                        # state); keep one, drop duplicates' STAGING dirs only.
+                        self._drop_staged(rep["checkpoint_dir"])
                     else:
                         ent["ckpt"] = (rep["checkpoint_dir"], rep["metrics"])
         for seq in sorted(by_seq):
             ent = by_seq[seq]
             metrics = ent["metrics"] or (ent["ckpt"][1] if ent["ckpt"] else {})
-            if ent["ckpt"]:
+            if ent["ckpt"] and seq not in self._seen_ckpt_seqs:
+                self._seen_ckpt_seqs.add(seq)
                 # A lost/corrupt checkpoint dir must not kill the run: the
                 # metrics are still valid, and training continues from the
                 # previous registered checkpoint.
@@ -173,7 +198,8 @@ class TrainController:
                     self.ckpt_manager.register(ent["ckpt"][0], metrics)
                 except OSError:
                     traceback.print_exc()
-            if metrics:
+            if metrics and seq not in self._seen_metric_seqs:
+                self._seen_metric_seqs.add(seq)
                 self.metrics_history.append(metrics)
                 self.latest_metrics = metrics
 

@@ -25,13 +25,15 @@ _session_lock = threading.Lock()
 class TrainSession:
     def __init__(self, world_rank: int, world_size: int, local_rank: int,
                  experiment_name: str, storage_path: str,
-                 resume_checkpoint: Optional[Checkpoint] = None):
+                 resume_checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[dict] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
         self.experiment_name = experiment_name
         self.storage_path = storage_path
         self.resume_checkpoint = resume_checkpoint
+        self.dataset_shards = dataset_shards or {}
         self.reports: "queue.Queue[dict]" = queue.Queue()
         self.stop_event = threading.Event()
         self._report_seq = 0
@@ -70,6 +72,15 @@ class TrainSession:
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.resume_checkpoint
 
+    def get_dataset_shard(self, name: str = "train"):
+        """This worker's split of the named Dataset (a DataIterator)."""
+        if name not in self.dataset_shards:
+            raise KeyError(
+                f"no dataset shard {name!r}; trainer datasets= keys: "
+                f"{sorted(self.dataset_shards)}"
+            )
+        return self.dataset_shards[name]
+
     def drain_reports(self) -> list[dict]:
         out = []
         while True:
@@ -103,6 +114,9 @@ class TrainContext:
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self._s.get_checkpoint()
 
+    def get_dataset_shard(self, name: str = "train"):
+        return self._s.get_dataset_shard(name)
+
 
 def _set_session(s: "TrainSession | None"):
     global _session
@@ -131,3 +145,10 @@ def get_context() -> TrainContext:
 def get_checkpoint() -> Optional[Checkpoint]:
     s = _get_session()
     return s.get_checkpoint() if s else None
+
+
+def get_dataset_shard(name: str = "train"):
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("get_dataset_shard() called outside a train worker")
+    return s.get_dataset_shard(name)

@@ -24,12 +24,17 @@ class DataParallelTrainer:
         train_loop_config: Optional[dict] = None,
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
+        datasets: Optional[dict] = None,
         controller_as_actor: bool = True,
     ):
         self.train_fn = train_loop_per_worker
         self.train_config = train_loop_config or {}
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        # {name: ray_tpu.data.Dataset}; each gets streaming_split across the
+        # gang, consumed in the train fn via train.get_dataset_shard(name)
+        # (reference: DataParallelTrainer datasets= + data_config.py:13).
+        self.datasets = datasets or {}
         self.controller_as_actor = controller_as_actor
 
     def fit(self) -> Result:
@@ -41,11 +46,13 @@ class DataParallelTrainer:
             # hence a tiny max_concurrency bump.
             Controller = rt.remote(TrainController)
             handle = Controller.options(max_concurrency=2, num_cpus=0).remote(
-                self.train_fn, self.train_config, self.scaling, self.run_config
+                self.train_fn, self.train_config, self.scaling, self.run_config,
+                datasets=self.datasets,
             )
             return rt.get(handle.run.remote(), timeout=None)
         return TrainController(
-            self.train_fn, self.train_config, self.scaling, self.run_config
+            self.train_fn, self.train_config, self.scaling, self.run_config,
+            datasets=self.datasets,
         ).run()
 
 

@@ -70,7 +70,8 @@ class TrainWorker:
 
     # -- training lifecycle ------------------------------------------------
     def start(self, train_fn: Callable, config: dict,
-              resume_checkpoint_path: Optional[str] = None) -> bool:
+              resume_checkpoint_path: Optional[str] = None,
+              dataset_shards: Optional[dict] = None) -> bool:
         resume = Checkpoint(resume_checkpoint_path) if resume_checkpoint_path else None
         self.session = TrainSession(
             world_rank=self.world_rank,
@@ -79,6 +80,7 @@ class TrainWorker:
             experiment_name=self.experiment_name,
             storage_path=self.storage_path,
             resume_checkpoint=resume,
+            dataset_shards=dataset_shards,
         )
         self.error = None
         self.finished = False
@@ -179,11 +181,21 @@ class WorkerGroup:
         )
 
     def run(self, train_fn: Callable, config: dict,
-            resume_checkpoint_path: Optional[str] = None) -> None:
+            resume_checkpoint_path: Optional[str] = None,
+            datasets: Optional[dict] = None) -> None:
+        # Fresh streaming splits per gang incarnation: a restarted gang must
+        # not consume a half-drained epoch from the previous one (reference:
+        # DataConfig.configure runs per worker-group start).
+        shards_per_worker: list[dict] = [{} for _ in self.workers]
+        for ds_name, ds in (datasets or {}).items():
+            iterators = ds.streaming_split(len(self.workers))
+            for i, it in enumerate(iterators):
+                shards_per_worker[i][ds_name] = it
         rt.get(
             [
-                w.start.remote(train_fn, config, resume_checkpoint_path)
-                for w in self.workers
+                w.start.remote(train_fn, config, resume_checkpoint_path,
+                               shards_per_worker[i])
+                for i, w in enumerate(self.workers)
             ],
             timeout=60,
         )
