@@ -79,22 +79,10 @@ def _col_to_numpy(col: "pa.ChunkedArray") -> np.ndarray:
     stacked [rows, ...] ndarray rather than an object array.
     """
     col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
-    typ = col.type
-    if pa.types.is_fixed_size_list(typ):
-        # flatten() respects slice offsets; .values would not.
-        flat = col.flatten().to_numpy(zero_copy_only=False)
-        return flat.reshape(len(col), typ.list_size)
-    if pa.types.is_list(typ) or pa.types.is_large_list(typ):
-        # Uniform-length list columns (tensor columns) reshape without
-        # boxing; ragged ones fall back to an object array.
-        offsets = col.offsets.to_numpy(zero_copy_only=False)
-        widths = np.diff(offsets)
-        if len(col) and col.null_count == 0 and (widths == widths[0]).all():
-            try:
-                flat = col.flatten().to_numpy(zero_copy_only=False)
-                return flat.reshape(len(col), int(widths[0]))
-            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
-                pass
+    if _is_list_type(col.type):
+        arr = _tensor_col_to_numpy(col)
+        if arr is not None:
+            return arr
         values = col.to_pylist()
         try:
             return np.asarray(values)  # ragged -> ValueError / object array
@@ -106,6 +94,45 @@ def _col_to_numpy(col: "pa.ChunkedArray") -> np.ndarray:
         return col.to_numpy(zero_copy_only=False)
     except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
         return np.asarray(col.to_pylist())
+
+
+def _is_list_type(typ) -> bool:
+    return (pa.types.is_list(typ) or pa.types.is_large_list(typ)
+            or pa.types.is_fixed_size_list(typ))
+
+
+def _tensor_col_to_numpy(col: "pa.Array") -> Optional[np.ndarray]:
+    """Uniform N-D tensor column -> stacked ndarray without Python boxing.
+
+    Unnests every list level (flatten() respects slice offsets), verifying
+    per-level uniform widths and absence of nulls; returns None for anything
+    ragged or nulled (caller falls back to the boxed path).
+    """
+    shape = [len(col)]
+    arr = col
+    while _is_list_type(arr.type):
+        if arr.null_count:
+            return None
+        typ = arr.type
+        if pa.types.is_fixed_size_list(typ):
+            width = typ.list_size
+        else:
+            offsets = arr.offsets.to_numpy(zero_copy_only=False)
+            widths = np.diff(offsets)
+            if len(widths) == 0 or not (widths == widths[0]).all():
+                return None
+            width = int(widths[0])
+        shape.append(width)
+        arr = arr.flatten()
+    if arr.null_count:
+        return None
+    try:
+        flat = arr.to_numpy(zero_copy_only=False)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        return None
+    if flat.dtype == object:
+        return None
+    return flat.reshape(shape)
 
 
 def block_rows(block: Block) -> list[dict]:

@@ -69,7 +69,10 @@ class TrainController:
         # Seqs absorbed from the CURRENT gang (reset per restart: a restarted
         # gang re-reports from seq 1 and that re-done work is real).
         self._seen_ckpt_seqs: set[int] = set()
-        self._seen_metric_seqs: set[int] = set()
+        # seq -> (metrics_history index, came-from-rank-0): lets rank 0's
+        # canonical metrics replace a non-canonical fallback absorbed earlier.
+        self._metric_entries: dict[int, tuple[int, bool]] = {}
+        self._max_metric_seq = -1
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> Result:
@@ -81,7 +84,8 @@ class TrainController:
             try:
                 if group is None:
                     self._seen_ckpt_seqs.clear()
-                    self._seen_metric_seqs.clear()
+                    self._metric_entries.clear()
+                    self._max_metric_seq = -1
                     group = WorkerGroup(self.scaling, name, self.storage_path)
                     group.start()
                     resume = self.ckpt_manager.latest
@@ -188,6 +192,7 @@ class TrainController:
                         ent["ckpt"] = (rep["checkpoint_dir"], rep["metrics"])
         for seq in sorted(by_seq):
             ent = by_seq[seq]
+            canonical = ent["metrics"] is not None  # rank 0 reported this seq
             metrics = ent["metrics"] or (ent["ckpt"][1] if ent["ckpt"] else {})
             if ent["ckpt"] and seq not in self._seen_ckpt_seqs:
                 self._seen_ckpt_seqs.add(seq)
@@ -198,9 +203,21 @@ class TrainController:
                     self.ckpt_manager.register(ent["ckpt"][0], metrics)
                 except OSError:
                     traceback.print_exc()
-            if metrics and seq not in self._seen_metric_seqs:
-                self._seen_metric_seqs.add(seq)
+            if not metrics:
+                continue
+            prev = self._metric_entries.get(seq)
+            if prev is None:
                 self.metrics_history.append(metrics)
+                self._metric_entries[seq] = (len(self.metrics_history) - 1, canonical)
+            elif canonical and not prev[1]:
+                # Rank 0's metrics arrived a poll later than another rank's
+                # checkpoint fallback: canonical wins.
+                self.metrics_history[prev[0]] = metrics
+                self._metric_entries[seq] = (prev[0], True)
+            else:
+                continue
+            if seq >= self._max_metric_seq:
+                self._max_metric_seq = seq
                 self.latest_metrics = metrics
 
     def get_state(self) -> dict:

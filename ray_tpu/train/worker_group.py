@@ -132,6 +132,7 @@ class WorkerGroup:
         self.pg = None
         self.reservation = None
         self.workers: list = []
+        self._split_coordinators: list = []
 
     def start(self) -> None:
         n = self.scaling.num_workers
@@ -189,6 +190,8 @@ class WorkerGroup:
         shards_per_worker: list[dict] = [{} for _ in self.workers]
         for ds_name, ds in (datasets or {}).items():
             iterators = ds.streaming_split(len(self.workers))
+            # Coordinator actors die with the gang (shutdown), not the cluster.
+            self._split_coordinators.append(iterators[0]._coord)
             for i, it in enumerate(iterators):
                 shards_per_worker[i][ds_name] = it
         rt.get(
@@ -222,6 +225,12 @@ class WorkerGroup:
             except Exception:
                 pass
         self.workers = []
+        for coord in self._split_coordinators:
+            try:
+                rt.kill(coord)
+            except Exception:
+                pass
+        self._split_coordinators = []
         if self.pg is not None:
             try:
                 rt.remove_placement_group(self.pg)

@@ -1,0 +1,49 @@
+"""ray_tpu.tune: hyperparameter search over trial actors + placement groups.
+
+Reference surface: ray.tune (SURVEY.md §2.4 Tune row) — Tuner/TuneConfig,
+grid/random search spaces, ASHA + PBT schedulers, report/get_checkpoint
+from inside a trial fn (shared with ray_tpu.train's session, as in the
+reference where Train v2 runs on Tune).
+"""
+from ray_tpu.train.session import get_checkpoint, report
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    TrialResult,
+    TuneConfig,
+    TuneController,
+    Tuner,
+)
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "TrialResult",
+    "TrialScheduler",
+    "TuneConfig",
+    "TuneController",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "sample_from",
+    "uniform",
+]

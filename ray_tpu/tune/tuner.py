@@ -1,0 +1,314 @@
+"""Tuner + TuneController: trial FSM over actors and placement groups.
+
+Role-equivalent to the reference's Tuner (tune/tuner.py) and TuneController
+(/root/reference/python/ray/tune/execution/tune_controller.py:68 — trial
+state machine, actor-per-trial, PG-based resource booking). Trials run the
+user function in a TrainWorker-style actor (thread + report queue); the
+controller polls, feeds results to the scheduler, and applies decisions
+(ASHA early-stop; PBT exploit/explore restarts).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+import ray_tpu as rt
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.worker_group import TrainWorker
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.schedulers import CONTINUE, PERTURB, STOP, FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    num_samples: int = 1
+    metric: Optional[str] = None
+    mode: str = "max"
+    scheduler: Optional[TrialScheduler] = None
+    max_concurrent_trials: Optional[int] = None
+    resources_per_trial: dict = dataclasses.field(default_factory=dict)
+    seed: Optional[int] = None
+    max_failures_per_trial: int = 0
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    config: dict
+    metrics: dict
+    metrics_history: list
+    checkpoint: Optional[Checkpoint]
+    best_checkpoint: Optional[Checkpoint]
+    error: Optional[str]
+    path: str
+
+    @property
+    def success(self) -> bool:
+        return self.error is None
+
+
+class ResultGrid:
+    def __init__(self, results: list[TrialResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    @property
+    def errors(self) -> list[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (TuneConfig.metric or argument)")
+        scored = [r for r in self._results
+                  if r.error is None and metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no successful trial reported metric {metric!r}")
+        pick = max if mode == "max" else min
+        return pick(scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([
+            {"trial_id": r.trial_id, **_flatten(r.config, "config"),
+             **r.metrics}
+            for r in self._results
+        ])
+
+
+def _flatten(d: dict, prefix: str) -> dict:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}/{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+class Trial:
+    """One configuration's lifecycle: PENDING -> RUNNING -> (PERTURBED ->
+    RUNNING)* -> TERMINATED | ERRORED."""
+
+    def __init__(self, trial_id: str, config: dict, storage_path: str,
+                 resources: dict):
+        self.trial_id = trial_id
+        self.config = config
+        self.path = storage_path
+        self.resources = resources
+        self.ckpt_manager = CheckpointManager(storage_path)
+        self.state = "PENDING"
+        self.actor = None
+        self.pg = None
+        self.metrics: dict = {}
+        self.metrics_history: list[dict] = []
+        self.iteration = 0
+        self.error: Optional[str] = None
+        self.failures = 0
+        self.pbt_exploit: Optional[str] = None  # donor trial id (set by PBT)
+        self.resume_path: Optional[str] = None
+
+    def result(self) -> TrialResult:
+        return TrialResult(
+            trial_id=self.trial_id,
+            config=self.config,
+            metrics=self.metrics,
+            metrics_history=self.metrics_history,
+            checkpoint=self.ckpt_manager.latest,
+            best_checkpoint=self.ckpt_manager.best,
+            error=self.error,
+            path=self.path,
+        )
+
+
+class TuneController:
+    """Drives all trials to completion (reference: tune_controller.py:68)."""
+
+    def __init__(self, trainable: Callable, trials: list[Trial],
+                 tune_config: TuneConfig, poll_interval_s: float = 0.1):
+        self.trainable = trainable
+        self.trials = trials
+        self.cfg = tune_config
+        self.scheduler = tune_config.scheduler or FIFOScheduler()
+        self.poll_interval_s = poll_interval_s
+        self._by_id = {t.trial_id: t for t in trials}
+
+    # -- lifecycle ---------------------------------------------------------
+    def _try_start(self, trial: Trial) -> bool:
+        res = dict(trial.resources) or {"CPU": 1.0}
+        pg = rt.placement_group([res], strategy="PACK",
+                                name=f"tune-{trial.trial_id}")
+        if not pg.ready(timeout=2.0):
+            rt.remove_placement_group(pg)
+            return False
+        worker_cls = rt.remote(TrainWorker)
+        trial.pg = pg
+        trial.actor = worker_cls.options(
+            placement_group=pg, placement_group_bundle_index=0,
+            resources=res, max_concurrency=4,
+        ).remote(0, 1, trial.trial_id, trial.path)
+        # Fire-and-forget: actor cold-start (worker spawn) must not serialize
+        # trial launches. A failed start surfaces through the first poll.
+        trial.actor.start.remote(self.trainable, trial.config, trial.resume_path)
+        trial.state = "RUNNING"
+        return True
+
+    def _teardown(self, trial: Trial) -> None:
+        if trial.actor is not None:
+            try:
+                rt.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        if trial.pg is not None:
+            try:
+                rt.remove_placement_group(trial.pg)
+            except Exception:
+                pass
+            trial.pg = None
+
+    # -- decisions ---------------------------------------------------------
+    def _apply_perturb(self, trial: Trial) -> None:
+        """PBT exploit/explore: clone donor checkpoint, mutate config,
+        restart in place."""
+        donor = self._by_id.get(trial.pbt_exploit or "")
+        trial.pbt_exploit = None
+        donor_ckpt = donor.ckpt_manager.latest if donor else None
+        if donor is None or donor_ckpt is None:
+            return  # nothing to exploit yet: keep running as-is
+        self._teardown(trial)
+        trial.config = self.scheduler.explore(dict(donor.config))
+        trial.resume_path = donor_ckpt.path
+        trial.state = "PENDING"  # restart via the normal scheduling path
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> list[TrialResult]:
+        cap = self.cfg.max_concurrent_trials or len(self.trials)
+        while True:
+            running = [t for t in self.trials if t.state == "RUNNING"]
+            pending = [t for t in self.trials if t.state == "PENDING"]
+            if not running and not pending:
+                break
+            for trial in pending:
+                if len(running) >= cap:
+                    break
+                try:
+                    if self._try_start(trial):
+                        running.append(trial)
+                    else:
+                        break  # no capacity right now; retry next cycle
+                except Exception:
+                    trial.error = traceback.format_exc()
+                    trial.state = "ERRORED"
+                    self._teardown(trial)
+            made_progress = False
+            for trial in list(running):
+                made_progress |= self._poll_trial(trial)
+            if not made_progress:
+                time.sleep(self.poll_interval_s)
+        return [t.result() for t in self.trials]
+
+    def _poll_trial(self, trial: Trial) -> bool:
+        try:
+            status = rt.get(trial.actor.poll.remote(), timeout=60)
+        except Exception as e:
+            return self._on_trial_failed(trial, f"trial actor died: {e}")
+        progressed = False
+        decision = CONTINUE
+        for rep in status["reports"]:
+            progressed = True
+            metrics = dict(rep["metrics"])
+            trial.iteration += 1
+            metrics.setdefault("training_iteration", trial.iteration)
+            if rep.get("checkpoint_dir"):
+                try:
+                    trial.ckpt_manager.register(rep["checkpoint_dir"], metrics)
+                except OSError:
+                    traceback.print_exc()
+            trial.metrics = metrics
+            trial.metrics_history.append(metrics)
+            d = self.scheduler.on_trial_result(trial, metrics)
+            if d != CONTINUE:
+                decision = d
+        if decision == STOP:
+            self._teardown(trial)
+            trial.state = "TERMINATED"
+            self.scheduler.on_trial_complete(trial, trial.metrics)
+            return True
+        if decision == PERTURB:
+            self._apply_perturb(trial)
+            return True
+        if status["error"]:
+            return self._on_trial_failed(trial, status["error"])
+        if status["finished"]:
+            self._teardown(trial)
+            trial.state = "TERMINATED"
+            self.scheduler.on_trial_complete(trial, trial.metrics)
+            return True
+        return progressed
+
+    def _on_trial_failed(self, trial: Trial, err: str) -> bool:
+        self._teardown(trial)
+        trial.failures += 1
+        if trial.failures > self.cfg.max_failures_per_trial:
+            trial.error = err
+            trial.state = "ERRORED"
+            self.scheduler.on_trial_complete(trial, None)
+        else:
+            resume = trial.ckpt_manager.latest
+            trial.resume_path = resume.path if resume else None
+            trial.state = "PENDING"
+        return True
+
+
+class Tuner:
+    """Public API (reference: tune/tuner.py Tuner.fit -> ResultGrid)."""
+
+    def __init__(self, trainable: Callable, *, param_space: dict,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None):
+        from ray_tpu.train.config import RunConfig
+
+        self.trainable = trainable
+        self.param_space = param_space
+        self.cfg = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig(name="tune_run")
+
+    def fit(self) -> ResultGrid:
+        if not rt.is_initialized():
+            rt.init()
+        storage = self.run_config.resolved_storage_path()
+        configs = generate_variants(
+            self.param_space, self.cfg.num_samples, self.cfg.seed
+        )
+        trials = [
+            Trial(
+                trial_id=f"trial_{i:05d}",
+                config=cfg,
+                storage_path=os.path.join(storage, f"trial_{i:05d}"),
+                resources=dict(self.cfg.resources_per_trial),
+            )
+            for i, cfg in enumerate(configs)
+        ]
+        controller = TuneController(self.trainable, trials, self.cfg)
+        results = controller.run()
+        return ResultGrid(results, self.cfg.metric, self.cfg.mode)

@@ -142,6 +142,13 @@ def test_from_converters(shared_ray):
     assert batch["data"].shape == (4, 2)
 
 
+def test_nd_tensor_columns(shared_ray):
+    ds = rd.range_tensor(6, shape=(2, 3), parallelism=2)
+    batch = ds.take_batch(6)
+    assert batch["data"].shape == (6, 2, 3)
+    assert batch["data"].dtype != object
+
+
 def test_column_ops(shared_ray):
     ds = rd.from_items([{"a": i, "b": i * 2} for i in range(6)])
     added = ds.add_column("c", lambda r: r["a"] + r["b"]).take_all()
