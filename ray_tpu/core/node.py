@@ -37,6 +37,7 @@ class WorkerRecord:
     actor_ids: list = field(default_factory=list)
     ready: asyncio.Future | None = None
     last_idle_ts: float = 0.0
+    death_reported: bool = False
 
 
 class NodeDaemon:
@@ -189,10 +190,18 @@ class NodeDaemon:
         if record in self.idle_workers:
             self.idle_workers.remove(record)
         logger.warning("worker %s died (actors=%s)", record.worker_id[:8], [a.hex()[:8] for a in map(_as_actor, record.actor_ids)])
+        await self._report_worker_died(record, "worker process died")
+
+    async def _report_worker_died(self, record: WorkerRecord, reason: str):
+        """Tell the controller (exactly once per worker) so actor FSMs advance
+        (reference: raylet NodeManager -> GcsActorManager::OnWorkerDead)."""
+        if record.death_reported:
+            return
+        record.death_reported = True
         try:
             await self.controller.call(
                 "worker_died",
-                {"worker_id": record.worker_id, "actor_ids": record.actor_ids, "reason": "worker process died", "node_id": self.node_id},
+                {"worker_id": record.worker_id, "actor_ids": record.actor_ids, "reason": reason, "node_id": self.node_id},
             )
         except Exception:
             pass
@@ -251,12 +260,18 @@ class NodeDaemon:
         return True
 
     def _kill_worker_proc(self, record: WorkerRecord, reason: str):
+        already_dead = record.state == "DEAD"
         record.state = "DEAD"
         self.workers.pop(record.worker_id, None)
         if record in self.idle_workers:
             self.idle_workers.remove(record)
         if record.proc is not None and record.proc.poll() is None:
             record.proc.kill()
+        # A daemon-initiated kill closes the conn AFTER state flips to DEAD,
+        # so _on_worker_conn_closed won't report — report here or restartable
+        # actors (max_restarts) would never leave ALIVE in the controller.
+        if not already_dead and record.actor_ids:
+            asyncio.get_event_loop().create_task(self._report_worker_died(record, reason))
 
     # -- object plane ---------------------------------------------------
     async def handle_pull_object(self, conn, p):

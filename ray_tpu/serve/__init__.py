@@ -1,0 +1,43 @@
+"""ray_tpu.serve: model/app serving on the ray_tpu runtime.
+
+Capability-parity target: /root/reference/python/ray/serve (controller,
+replicas, HTTP proxy, pow-2 router, autoscaling, batching) — see each
+submodule's docstring for the reference mapping. The LLM serving engine
+(continuous batching on the flagship JAX transformer) lives in
+ray_tpu.serve.llm.
+"""
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    http_port,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.proxy import Request
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "Request",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "http_port",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

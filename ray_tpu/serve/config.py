@@ -1,0 +1,47 @@
+"""Serve configuration types.
+
+Role-equivalent to the reference's deployment/autoscaling configs
+(/root/reference/python/ray/serve/config.py — AutoscalingConfig,
+python/ray/serve/_private/config.py — DeploymentConfig). Redesigned as plain
+dataclasses; the autoscaling model is the reference's v2 one: handles report
+queued+ongoing demand, the controller targets `target_ongoing_requests` per
+replica (autoscaling_state.py:_get_total_num_requests).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_ongoing_requests: float = 2.0
+    # Decisions must hold for these windows before they are applied
+    # (reference: upscale_delay_s / downscale_delay_s).
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    metrics_interval_s: float = 0.25
+
+    def desired(self, total_demand: float) -> int:
+        import math
+
+        want = math.ceil(total_demand / max(self.target_ongoing_requests, 1e-9))
+        return max(self.min_replicas, min(self.max_replicas, want))
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    user_config: Any = None
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+    health_check_period_s: float = 2.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+    def initial_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            return self.autoscaling_config.min_replicas
+        return self.num_replicas

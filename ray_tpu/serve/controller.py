@@ -1,0 +1,387 @@
+"""ServeController: checkpointed control loop for all serve apps.
+
+Role-equivalent to the reference's ServeController
+(/root/reference/python/ray/serve/_private/controller.py:106 — a detached
+actor that owns the deployment tables, runs the reconciliation control loop,
+checkpoints to the GCS KV, and is recovered by actor restart) and its
+DeploymentState machinery (deployment_state.py — replica start/stop/health)
+and AutoscalingState (autoscaling_state.py — handle-demand driven decisions).
+
+Redesign notes: one reconcile thread replaces the reference's asyncio
+control-loop tasks; state checkpoints go to the cluster controller's KV
+(equivalent of the GCS internal KV). Replicas are detached named actors so a
+restarted ServeController re-adopts them by name instead of restarting them.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+import traceback
+from typing import Any, Optional
+
+from ray_tpu.core import serialization
+
+SERVE_NAMESPACE = "serve"
+CONTROLLER_NAME = "__serve_controller__"
+CHECKPOINT_KEY = "serve:checkpoint"
+
+
+def _kv_put(key: str, value: bytes):
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    core._run(core.controller.call("kv_put", {"ns": SERVE_NAMESPACE, "key": key, "value": value}))
+
+
+def _kv_get(key: str) -> Optional[bytes]:
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    return core._run(core.controller.call("kv_get", {"ns": SERVE_NAMESPACE, "key": key}))
+
+
+def _kv_del(key: str):
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    core._run(core.controller.call("kv_del", {"ns": SERVE_NAMESPACE, "key": key}))
+
+
+class _DeploymentState:
+    """Desired + actual state for one deployment in one app."""
+
+    def __init__(self, app_name: str, spec: dict):
+        self.app = app_name
+        self.spec = spec  # {name, blob, config-dict, route_prefix}
+        self.replicas: dict[str, Any] = {}  # name -> ActorHandle
+        self.version = 0
+        self.target = spec["config"]["initial_replicas"]
+        self.demand: dict[int, tuple[float, float]] = {}  # handle_id -> (demand, ts)
+        self.last_upscale_ok: Optional[float] = None
+        self.last_downscale_ok: Optional[float] = None
+        self.status = "UPDATING"
+
+    @property
+    def name(self) -> str:
+        return self.spec["name"]
+
+
+class ServeController:
+    """Detached actor; restart-recoverable from its KV checkpoint."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.apps: dict[str, dict[str, _DeploymentState]] = {}
+        self.routes: dict[str, tuple[str, str]] = {}  # prefix -> (app, deployment)
+        self.http_port: Optional[int] = None
+        self._stop = threading.Event()
+        self._restore()
+        self._thread = threading.Thread(target=self._control_loop, name="serve-ctl", daemon=True)
+        self._thread.start()
+
+    # -- public control API (called via actor methods) ---------------------
+    def deploy_app(self, app_name: str, specs: list[dict], route_prefix: Optional[str]):
+        """specs: [{name, blob(bytes: (callable,args,kwargs,user_config)),
+        config: dict}] dependency-first; last one is the ingress."""
+        with self.lock:
+            old = self.apps.get(app_name, {})
+            new: dict[str, _DeploymentState] = {}
+            for spec in specs:
+                prev = old.get(spec["name"])
+                if prev is not None and prev.spec["blob"] == spec["blob"] and prev.spec["config"] == spec["config"]:
+                    new[spec["name"]] = prev  # unchanged: keep replicas
+                else:
+                    st = _DeploymentState(app_name, spec)
+                    if prev is not None:
+                        # Code/config changed: keep old replicas for teardown,
+                        # bump version so routers re-resolve.
+                        st.replicas = prev.replicas
+                        st.version = prev.version + 1
+                        if prev.spec["config"] == spec["config"]:
+                            st.target = prev.target
+                    new[spec["name"]] = st
+            removed = [d for n, d in old.items() if n not in new]
+            self.apps[app_name] = new
+            self.routes = {p: t for p, t in self.routes.items() if t[0] != app_name}
+            if route_prefix is not None:
+                ingress = specs[-1]["name"]
+                self.routes[route_prefix] = (app_name, ingress)
+        for dep in removed:
+            self._stop_all_replicas(dep)
+        self._checkpoint()
+
+    def delete_app(self, app_name: str):
+        with self.lock:
+            deps = list(self.apps.pop(app_name, {}).values())
+            self.routes = {p: t for p, t in self.routes.items() if t[0] != app_name}
+        for dep in deps:
+            self._stop_all_replicas(dep)
+        self._checkpoint()
+
+    def shutdown(self):
+        with self.lock:
+            apps = list(self.apps)
+        for a in apps:
+            self.delete_app(a)
+        _kv_del(CHECKPOINT_KEY)
+        self._stop.set()
+
+    def set_http_port(self, port: int):
+        with self.lock:
+            self.http_port = port
+        self._checkpoint()
+
+    # -- routing / status (called by handles + proxy) ----------------------
+    def get_routing_info(self, app_name: str, deployment_name: str) -> Optional[dict]:
+        with self.lock:
+            dep = self.apps.get(app_name, {}).get(deployment_name)
+            if dep is None:
+                return None
+            return {
+                "replica_names": [n for n in dep.replicas],
+                "version": dep.version,
+                "max_ongoing_requests": dep.spec["config"]["max_ongoing_requests"],
+            }
+
+    def get_route_table(self) -> dict:
+        with self.lock:
+            return {p: {"app": a, "deployment": d} for p, (a, d) in self.routes.items()}
+
+    def get_http_port(self) -> Optional[int]:
+        with self.lock:
+            return self.http_port
+
+    def record_handle_metrics(self, app: str, deployment: str, handle_id: int, demand: float, ts: float):
+        with self.lock:
+            dep = self.apps.get(app, {}).get(deployment)
+            if dep is not None:
+                dep.demand[handle_id] = (demand, ts)
+
+    def get_status(self) -> dict:
+        with self.lock:
+            return {
+                "http_port": self.http_port,
+                "apps": {
+                    a: {
+                        d.name: {
+                            "status": d.status,
+                            "target": d.target,
+                            "replicas": len(d.replicas),
+                            "version": d.version,
+                        }
+                        for d in deps.values()
+                    }
+                    for a, deps in self.apps.items()
+                },
+            }
+
+    def ping(self) -> bool:
+        return True
+
+    # -- control loop ------------------------------------------------------
+    def _control_loop(self):
+        import ray_tpu as rt  # noqa: F401  (ensures API ready in this process)
+
+        last_health = 0.0
+        while not self._stop.is_set():
+            try:
+                with self.lock:
+                    deps = [d for app in self.apps.values() for d in app.values()]
+                changed = False
+                for dep in deps:
+                    changed |= self._autoscale(dep)
+                    changed |= self._reconcile(dep)
+                if time.time() - last_health > 2.0:
+                    last_health = time.time()
+                    for dep in deps:
+                        changed |= self._health_check(dep)
+                if changed:
+                    self._checkpoint()
+            except Exception:
+                traceback.print_exc()
+            self._stop.wait(0.1)
+
+    def _reconcile(self, dep: _DeploymentState) -> bool:
+        """Drive actual replica count to dep.target."""
+        changed = False
+        with self.lock:
+            want = dep.target
+            have = len(dep.replicas)
+        while have < want:
+            if self._start_replica(dep):
+                changed = True
+                have += 1
+            else:
+                break  # no capacity now; retry next tick
+        if have > want:
+            with self.lock:
+                excess = list(dep.replicas)[want - have :]
+            for name in excess:
+                self._stop_replica(dep, name)
+            changed = True
+        with self.lock:
+            dep.status = "HEALTHY" if len(dep.replicas) >= dep.target else "UPDATING"
+        return changed
+
+    def _start_replica(self, dep: _DeploymentState) -> bool:
+        import ray_tpu as rt
+        from ray_tpu.serve.replica import Replica
+
+        callable_, args, kwargs, user_config = serialization.deserialize(dep.spec["blob"])
+        rid = f"{dep.name}#{random.randrange(16**6):06x}"
+        actor_name = f"{dep.app}:{rid}"
+        cfg = dep.spec["config"]
+        aopts = dict(cfg.get("ray_actor_options") or {})
+        try:
+            handle = (
+                rt.remote(Replica)
+                .options(
+                    name=actor_name,
+                    namespace=SERVE_NAMESPACE,
+                    lifetime="detached",
+                    max_concurrency=cfg["max_ongoing_requests"] + 4,
+                    num_cpus=float(aopts.get("num_cpus", 0.0)),
+                    resources=dict(aopts.get("resources", {})),
+                )
+                .remote(dep.app, dep.name, rid, callable_, args, kwargs, user_config)
+            )
+            # Block until constructed so routing info only advertises live
+            # replicas (reference waits for replica init too).
+            rt.get(handle.check_health.remote(), timeout=60)
+        except Exception:
+            traceback.print_exc()
+            return False
+        with self.lock:
+            dep.replicas[actor_name] = handle
+            dep.version += 1
+        return True
+
+    def _stop_replica(self, dep: _DeploymentState, name: str):
+        import ray_tpu as rt
+
+        with self.lock:
+            handle = dep.replicas.pop(name, None)
+            dep.version += 1
+        if handle is None:
+            return
+        try:
+            rt.get(handle.prepare_for_shutdown.remote(), timeout=6)
+        except Exception:
+            pass
+        try:
+            rt.kill(handle)
+        except Exception:
+            pass
+
+    def _stop_all_replicas(self, dep: _DeploymentState):
+        with self.lock:
+            names = list(dep.replicas)
+        for n in names:
+            self._stop_replica(dep, n)
+
+    def _health_check(self, dep: _DeploymentState) -> bool:
+        import ray_tpu as rt
+
+        with self.lock:
+            items = list(dep.replicas.items())
+        dead = []
+        for name, handle in items:
+            try:
+                ok = rt.get(handle.check_health.remote(), timeout=10)
+            except Exception:
+                ok = False
+            if not ok:
+                dead.append(name)
+        for name in dead:
+            with self.lock:
+                dep.replicas.pop(name, None)
+                dep.version += 1
+            # Best-effort kill in case it's alive-but-unhealthy.
+            try:
+                rt.kill(rt.get_actor(name, namespace=SERVE_NAMESPACE))
+            except Exception:
+                pass
+        return bool(dead)
+
+    def _autoscale(self, dep: _DeploymentState) -> bool:
+        cfg = dep.spec["config"]
+        auto = cfg.get("autoscaling_config")
+        if not auto:
+            return False
+        from ray_tpu.serve.config import AutoscalingConfig
+
+        ac = AutoscalingConfig(**auto)
+        now = time.time()
+        with self.lock:
+            # Demand = most recent handle reports (stale ones expire).
+            dep.demand = {h: (d, ts) for h, (d, ts) in dep.demand.items() if now - ts < 5 * ac.metrics_interval_s + 1.0}
+            total = sum(d for d, _ in dep.demand.values())
+            desired = ac.desired(total)
+            cur = dep.target
+            if desired > cur:
+                dep.last_downscale_ok = None
+                if dep.last_upscale_ok is None:
+                    dep.last_upscale_ok = now
+                if now - dep.last_upscale_ok >= ac.upscale_delay_s:
+                    dep.target = desired
+                    dep.last_upscale_ok = None
+                    return True
+            elif desired < cur:
+                dep.last_upscale_ok = None
+                if dep.last_downscale_ok is None:
+                    dep.last_downscale_ok = now
+                if now - dep.last_downscale_ok >= ac.downscale_delay_s:
+                    dep.target = desired
+                    dep.last_downscale_ok = None
+                    return True
+            else:
+                dep.last_upscale_ok = dep.last_downscale_ok = None
+        return False
+
+    # -- checkpoint / restore ---------------------------------------------
+    def _checkpoint(self):
+        with self.lock:
+            state = {
+                "http_port": self.http_port,
+                "routes": dict(self.routes),
+                "apps": {
+                    a: [
+                        {"spec": d.spec, "replica_names": list(d.replicas), "version": d.version, "target": d.target}
+                        for d in deps.values()
+                    ]
+                    for a, deps in self.apps.items()
+                },
+            }
+        blob, _ = serialization.serialize(state)
+        try:
+            _kv_put(CHECKPOINT_KEY, blob)
+        except Exception:
+            traceback.print_exc()
+
+    def _restore(self):
+        import ray_tpu as rt
+
+        try:
+            blob = _kv_get(CHECKPOINT_KEY)
+        except Exception:
+            return
+        if not blob:
+            return
+        state = serialization.deserialize(blob)
+        self.http_port = state.get("http_port")
+        self.routes = dict(state.get("routes", {}))
+        for app_name, deps in state.get("apps", {}).items():
+            table: dict[str, _DeploymentState] = {}
+            for rec in deps:
+                st = _DeploymentState(app_name, rec["spec"])
+                st.version = rec["version"] + 1  # force router re-resolve
+                st.target = rec["target"]
+                # Re-adopt surviving detached replicas by name.
+                for name in rec["replica_names"]:
+                    try:
+                        st.replicas[name] = rt.get_actor(name, namespace=SERVE_NAMESPACE)
+                    except ValueError:
+                        pass
+                table[rec["spec"]["name"]] = st
+            self.apps[app_name] = table

@@ -1,0 +1,272 @@
+"""DeploymentHandle: client-side router with power-of-two-choices balancing.
+
+Role-equivalent to the reference's DeploymentHandle + Pow2Router
+(/root/reference/python/ray/serve/handle.py,
+_private/request_router/pow_2_router.py:27 — pick two candidates, choose the
+one with fewer ongoing requests). Departures, by design:
+- Admission control is fully client-side: the router tracks per-replica
+  ongoing counts and never exceeds a replica's max_ongoing_requests; excess
+  demand queues in the handle (the reference queues in the router too).
+- Demand metrics (queued + ongoing) are pushed to the ServeController for
+  autoscaling (reference: autoscaling_state.py handle metrics).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Optional
+
+SERVE_NAMESPACE = "serve"
+CONTROLLER_NAME = "__serve_controller__"
+
+_registry_lock = threading.Lock()
+_replica_sets: dict[tuple, "_ReplicaSet"] = {}
+
+
+def _controller():
+    import ray_tpu as rt
+
+    return rt.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+
+def _replica_set(app_name: str, deployment_name: str) -> "_ReplicaSet":
+    key = (app_name, deployment_name)
+    with _registry_lock:
+        rs = _replica_sets.get(key)
+        if rs is None:
+            rs = _ReplicaSet(app_name, deployment_name)
+            _replica_sets[key] = rs
+        return rs
+
+
+def _reset_registry():
+    """Called by serve.shutdown(): drop cached membership and stop pushers."""
+    with _registry_lock:
+        for rs in _replica_sets.values():
+            rs.close()
+        _replica_sets.clear()
+
+
+class _ReplicaSet:
+    """Shared per-process routing state for one deployment."""
+
+    REFRESH_S = 1.0
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app = app_name
+        self.deployment = deployment_name
+        self.cond = threading.Condition()
+        self.replicas: list[Any] = []  # ActorHandles
+        self.max_ongoing = 8
+        self.ongoing: dict[int, int] = {}  # index -> in-flight count
+        self.version = -1
+        self.fetched_at = 0.0
+        self.queued = 0
+        self._closed = False
+        self._outstanding: list[tuple[Any, int]] = []  # (ref, replica_idx)
+        self._drainer: Optional[threading.Thread] = None
+        self._pusher: Optional[threading.Thread] = None
+
+    # -- membership --------------------------------------------------------
+    def _refresh_locked(self, force: bool = False):
+        now = time.time()
+        if not force and now - self.fetched_at < self.REFRESH_S and self.replicas:
+            return
+        import ray_tpu as rt
+
+        info = rt.get(
+            _controller().get_routing_info.remote(self.app, self.deployment),
+            timeout=30,
+        )
+        self.fetched_at = time.time()
+        if info is None:
+            self.replicas, self.version = [], -1
+            return
+        if info["version"] != self.version:
+            handles = []
+            for name in info["replica_names"]:
+                try:
+                    handles.append(rt.get_actor(name, namespace=SERVE_NAMESPACE))
+                except ValueError:
+                    continue  # replica died between snapshot and lookup
+            self.replicas = handles
+            self.version = info["version"]
+            self.max_ongoing = info["max_ongoing_requests"]
+            self.ongoing = {i: 0 for i in range(len(handles))}
+            self.cond.notify_all()
+
+    # -- routing -----------------------------------------------------------
+    def route(self, method: str, args: tuple, kwargs: dict, timeout_s: float = 60.0):
+        """Pick a replica (pow-2 choices), submit, return (ref, idx)."""
+        deadline = time.time() + timeout_s
+        with self.cond:
+            self.queued += 1
+            try:
+                while True:
+                    self._refresh_locked()
+                    idx = self._pick_locked()
+                    if idx is not None:
+                        self.ongoing[idx] += 1
+                        replica = self.replicas[idx]
+                        break
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"no replica of {self.app}/{self.deployment} had capacity "
+                            f"within {timeout_s}s"
+                        )
+                    # Re-poll membership at least every REFRESH_S while queued.
+                    self.cond.wait(timeout=min(remaining, self.REFRESH_S))
+                    self.fetched_at = 0.0  # force refresh after a wait
+            finally:
+                self.queued -= 1
+        try:
+            ref = replica.handle_request.remote(method, args, kwargs)
+        except Exception:
+            with self.cond:
+                self.ongoing[idx] -= 1
+                self.fetched_at = 0.0
+                self.cond.notify_all()
+            raise
+        with self.cond:
+            self._outstanding.append((ref, idx))
+            self._ensure_threads()
+        return ref, idx
+
+    def _pick_locked(self) -> Optional[int]:
+        live = [i for i in range(len(self.replicas)) if self.ongoing.get(i, 0) < self.max_ongoing]
+        if not live:
+            return None
+        if len(live) == 1:
+            return live[0]
+        a, b = random.sample(live, 2)
+        return a if self.ongoing[a] <= self.ongoing[b] else b
+
+    def fail_over(self, idx: int):
+        """A request observed this replica dead: force membership refresh."""
+        with self.cond:
+            self.version = -1
+            self.fetched_at = 0.0
+            self.cond.notify_all()
+
+    # -- background: completion drain + demand metrics ---------------------
+    def _ensure_threads(self):
+        if self._drainer is None or not self._drainer.is_alive():
+            self._drainer = threading.Thread(
+                target=self._drain_loop, name=f"serve-drain-{self.deployment}", daemon=True
+            )
+            self._drainer.start()
+        if self._pusher is None or not self._pusher.is_alive():
+            self._pusher = threading.Thread(
+                target=self._push_loop, name=f"serve-push-{self.deployment}", daemon=True
+            )
+            self._pusher.start()
+
+    def _drain_loop(self):
+        import ray_tpu as rt
+
+        idle_since = time.time()
+        while not self._closed:
+            with self.cond:
+                pending = list(self._outstanding)
+            if not pending:
+                if time.time() - idle_since > 10.0:
+                    return  # thread parks; recreated on next route()
+                time.sleep(0.01)
+                continue
+            idle_since = time.time()
+            refs = [r for r, _ in pending]
+            try:
+                ready, _ = rt.wait(refs, num_returns=len(refs), timeout=0.05)
+            except Exception:
+                ready = refs  # core shut down: release everything
+            if not ready:
+                continue
+            done = set(id(r) for r in ready)
+            with self.cond:
+                kept = []
+                for ref, idx in self._outstanding:
+                    if id(ref) in done:
+                        if idx in self.ongoing:
+                            self.ongoing[idx] = max(0, self.ongoing[idx] - 1)
+                    else:
+                        kept.append((ref, idx))
+                self._outstanding = kept
+                self.cond.notify_all()
+
+    def _push_loop(self):
+        last = None
+        while not self._closed:
+            time.sleep(0.25)
+            with self.cond:
+                demand = self.queued + sum(self.ongoing.values())
+            if demand == 0 and last in (0, None):
+                last = 0
+                continue
+            try:
+                _controller().record_handle_metrics.remote(
+                    self.app, self.deployment, id(self), demand, time.time()
+                )
+            except Exception:
+                pass
+            last = demand
+
+    def close(self):
+        self._closed = True
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference: handle.py
+    DeploymentResponse). `result()` retries once on replica death."""
+
+    def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict):
+        self._rs = rs
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+        self._ref, self._idx = rs.route(method, args, kwargs)
+
+    def result(self, timeout: float | None = 60.0):
+        import ray_tpu as rt
+        from ray_tpu.core.worker import ActorDiedError
+
+        for attempt in range(3):
+            try:
+                return rt.get(self._ref, timeout=timeout)
+            except ActorDiedError:
+                self._rs.fail_over(self._idx)
+                if attempt == 2:
+                    raise
+                self._ref, self._idx = self._rs.route(self._method, self._args, self._kwargs)
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    """Picklable handle to a deployment (rebuilds router state lazily in the
+    destination process, so it can be shipped as a bind() init arg)."""
+
+    def __init__(self, deployment_name: str, app_name: str = "default", method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self.method_name = method_name
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self.app_name, method_name)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, self.app_name, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        rs = _replica_set(self.app_name, self.deployment_name)
+        return DeploymentResponse(rs, self.method_name, args, kwargs)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.app_name, self.method_name))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.app_name}/{self.deployment_name}.{self.method_name})"

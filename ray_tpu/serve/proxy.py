@@ -1,0 +1,177 @@
+"""HTTP proxy actor: routes requests to deployment handles.
+
+Role-equivalent to the reference's ProxyActor / HTTPProxy
+(/root/reference/python/ray/serve/_private/proxy.py:710 — per-node ASGI
+server resolving routes from the controller and streaming to replicas).
+Redesigned: a stdlib asyncio HTTP/1.1 server inside an actor (no ASGI
+dependency); blocking router/get calls are pushed to a thread pool so the
+accept loop never stalls.
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import socket
+import threading
+import time
+import traceback
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlsplit
+
+
+class Request:
+    """What an HTTP deployment's __call__ receives."""
+
+    def __init__(self, method: str, path: str, query: dict, headers: dict, body: bytes):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode()
+
+    def __reduce__(self):
+        return (Request, (self.method, self.path, self.query, self.headers, self.body))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ProxyActor:
+    """One per serving node (tests run one). Owns port + route cache."""
+
+    ROUTE_TTL_S = 1.0
+
+    def __init__(self, port: int = 0):
+        self.port = port or _free_port()
+        self._routes: dict[str, tuple[str, str]] = {}
+        self._routes_at = 0.0
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=32, thread_name_prefix="proxy")
+        self._loop = asyncio.new_event_loop()
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve, name="serve-proxy", daemon=True)
+        self._thread.start()
+        if not self._ready.wait(timeout=10):
+            raise RuntimeError("proxy HTTP server failed to start")
+
+    def get_port(self) -> int:
+        return self.port
+
+    def check_health(self) -> bool:
+        return self._thread.is_alive()
+
+    # -- server ------------------------------------------------------------
+    def _serve(self):
+        asyncio.set_event_loop(self._loop)
+
+        async def start():
+            server = await asyncio.start_server(self._handle_conn, "127.0.0.1", self.port)
+            self._ready.set()
+            async with server:
+                await server.serve_forever()
+
+        try:
+            self._loop.run_until_complete(start())
+        except Exception:
+            traceback.print_exc()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                try:
+                    method, target, _ = line.decode().split(" ", 2)
+                except ValueError:
+                    break
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", 0) or 0)
+                if n:
+                    body = await reader.readexactly(n)
+                status, payload, ctype = await self._loop.run_in_executor(
+                    self._pool, self._dispatch, method, target, headers, body
+                )
+                head = (
+                    f"HTTP/1.1 {status}\r\ncontent-type: {ctype}\r\n"
+                    f"content-length: {len(payload)}\r\nconnection: keep-alive\r\n\r\n"
+                )
+                writer.write(head.encode() + payload)
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            traceback.print_exc()
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    # -- routing (runs on thread pool) -------------------------------------
+    def _route_table(self) -> dict:
+        now = time.time()
+        if now - self._routes_at > self.ROUTE_TTL_S:
+            try:
+                import ray_tpu as rt
+                from ray_tpu.serve.handle import _controller
+
+                table = rt.get(_controller().get_route_table.remote(), timeout=10)
+                self._routes = {p: (t["app"], t["deployment"]) for p, t in table.items()}
+                self._routes_at = time.time()
+            except Exception:
+                self._routes_at = now  # back off; serve stale table
+        return self._routes
+
+    def _dispatch(self, method: str, target: str, headers: dict, body: bytes):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        parts = urlsplit(target)
+        path = parts.path or "/"
+        if path == "/-/healthz":
+            return "200 OK", b"ok", "text/plain"
+        if path == "/-/routes":
+            return "200 OK", json.dumps({p: f"{a}/{d}" for p, (a, d) in self._route_table().items()}).encode(), "application/json"
+        routes = self._route_table()
+        match = None
+        for prefix in sorted(routes, key=len, reverse=True):
+            norm = prefix.rstrip("/") or ""
+            if path == prefix or path.startswith(norm + "/") or path == norm:
+                match = (prefix, *routes[prefix])
+                break
+        if match is None:
+            return "404 Not Found", b'{"error": "no route"}', "application/json"
+        prefix, app, deployment = match
+        sub_path = path[len(prefix.rstrip("/")) :] or "/"
+        query = {k: v[0] if len(v) == 1 else v for k, v in parse_qs(parts.query).items()}
+        req = Request(method, sub_path, query, headers, body)
+        try:
+            result = DeploymentHandle(deployment, app).remote(req).result(timeout=60)
+        except Exception as e:
+            traceback.print_exc()
+            return "500 Internal Server Error", json.dumps({"error": str(e)}).encode(), "application/json"
+        if isinstance(result, bytes):
+            return "200 OK", result, "application/octet-stream"
+        if isinstance(result, str):
+            return "200 OK", result.encode(), "text/plain"
+        return "200 OK", json.dumps(result).encode(), "application/json"

@@ -1,0 +1,99 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Role-equivalent to the reference's RayServeReplica / UserCallableWrapper
+(/root/reference/python/ray/serve/_private/replica.py — request wrapper,
+ongoing-request accounting, reconfigure, health checks). Ordering departs
+from the reference: methods run on the actor's thread pool (max_concurrency
+sized to max_ongoing_requests), and admission control lives in the router,
+which never exceeds a replica's advertised capacity.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any
+
+
+class Replica:
+    """Generic replica actor body (created by the ServeController)."""
+
+    def __init__(
+        self,
+        app_name: str,
+        deployment_name: str,
+        replica_id: str,
+        user_callable: Any,
+        init_args: tuple,
+        init_kwargs: dict,
+        user_config: Any = None,
+    ):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._total = 0
+        self._started_at = time.time()
+        if isinstance(user_callable, type):
+            self._instance = user_callable(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            if init_args or init_kwargs:
+                raise TypeError("function deployments take no bind() args")
+            self._instance = user_callable
+            self._is_function = True
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- data path ---------------------------------------------------------
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                if method not in ("__call__", ""):
+                    raise AttributeError(
+                        f"function deployment {self.deployment_name} has no method {method!r}"
+                    )
+                fn = self._instance
+            else:
+                fn = getattr(self._instance, method or "__call__")
+            return fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # -- control path ------------------------------------------------------
+    def get_metrics(self) -> dict:
+        with self._lock:
+            return {
+                "replica_id": self.replica_id,
+                "ongoing": self._ongoing,
+                "total": self._total,
+                "uptime_s": time.time() - self._started_at,
+            }
+
+    def reconfigure(self, user_config: Any) -> None:
+        """Propagate dynamic config (reference: replica.py reconfigure)."""
+        if not self._is_function and hasattr(self._instance, "reconfigure"):
+            self._instance.reconfigure(user_config)
+
+    def check_health(self) -> bool:
+        if not self._is_function and hasattr(self._instance, "check_health"):
+            try:
+                self._instance.check_health()
+            except Exception:
+                traceback.print_exc()
+                return False
+        return True
+
+    def prepare_for_shutdown(self) -> None:
+        """Drain: wait (bounded) for ongoing requests to finish."""
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    return
+            time.sleep(0.02)
