@@ -343,6 +343,39 @@ int store_delete(void* sv, const uint8_t* id) {
   return 0;
 }
 
+// List LRU sealed+unpinned eviction candidates (WITHOUT removing them) whose
+// combined allocation would free nbytes beyond what is already available.
+// Lets the caller spill payloads to disk before deleting (reference: raylet
+// LocalObjectManager::SpillObjectUptoMaxThroughput chooses victims, writes
+// them via IO workers, then releases — local_object_manager.h:109).
+// Returns the number of candidate ids written to out_ids.
+int store_evict_candidates(void* sv, uint64_t nbytes, uint8_t* out_ids, uint32_t max_ids) {
+  Store* s = reinterpret_cast<Store*>(sv);
+  Header* h = s->hdr;
+  Guard g(h);
+  uint64_t avail = h->capacity - h->used;
+  if (avail >= nbytes) return 0;
+  uint64_t need = nbytes - avail;
+  uint64_t freed = 0;
+  uint32_t n = 0;
+  uint64_t last_tick = 0;
+  while (freed < need && n < max_ids) {
+    Entry* victim = nullptr;
+    for (uint32_t i = 0; i < kMaxObjects; i++) {
+      Entry* e = &h->table[i];
+      if (e->state == kSealed && e->refcount == 0 && e->lru_tick >= last_tick) {
+        if (!victim || e->lru_tick < victim->lru_tick) victim = e;
+      }
+    }
+    if (!victim) break;
+    memcpy(out_ids + (uint64_t)n * kIdSize, victim->id, kIdSize);
+    n++;
+    freed += alloc_size_for(victim->size);
+    last_tick = victim->lru_tick + 1;
+  }
+  return (int)n;
+}
+
 // Evict LRU sealed+unpinned objects until nbytes are free; evicted ids are
 // written to out_ids (kIdSize bytes each). Returns number evicted.
 int store_evict(void* sv, uint64_t nbytes, uint8_t* out_ids, uint32_t max_ids) {

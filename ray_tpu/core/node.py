@@ -89,7 +89,12 @@ class NodeDaemon:
                 self.resources.setdefault(k, v)
             for k, v in tpu_labels.items():
                 self.labels.setdefault(k, v)
-        self.store = SharedMemoryClient(self.store_path, capacity=self.store_capacity, create=True)
+        self.store = SharedMemoryClient(
+            self.store_path,
+            capacity=self.store_capacity,
+            create=True,
+            spill_dir=self.config.object_spill_dir or None,
+        )
         self.address = await self.server.start(port)
         self.controller = await rpc.connect(self.controller_addr, handler=self, timeout=self.config.rpc_connect_timeout_s)
         reply = await self.controller.call(
@@ -117,11 +122,16 @@ class NodeDaemon:
         if self.controller:
             await self.controller.close()
         if self.store:
+            spill_dir = self.store.spill_dir
             self.store.close()
             try:
                 os.unlink(self.store_path)
             except OSError:
                 pass
+            if spill_dir and os.path.isdir(spill_dir):
+                import shutil
+
+                shutil.rmtree(spill_dir, ignore_errors=True)
 
     async def _heartbeat_loop(self):
         while True:
@@ -280,6 +290,8 @@ class NodeDaemon:
         oid = ObjectID(p["oid"])
         if self.store.contains(oid):
             return {"ok": True}
+        if self._restore_local(oid):  # spilled locally: restore beats a network pull
+            return {"ok": True}
         key = oid.binary()
         if key in self._pulls:
             await self._pulls[key]
@@ -337,11 +349,27 @@ class NodeDaemon:
                 await src.close()
         return False
 
+    def _restore_local(self, oid: ObjectID) -> bool:
+        """Restore a spilled object into the arena, reporting any objects
+        truly evicted to make room (they have no spill copy)."""
+        evicted: list = []
+        ok = self.store.restore(oid, evicted_out=evicted)
+        if evicted:
+            asyncio.get_event_loop().create_task(
+                self.controller.notify(
+                    "report_objects_evicted", {"oids": [o.binary() for o in evicted], "node_id": self.node_id}
+                )
+            )
+        return ok
+
     def handle_object_info(self, conn, p):
         oid = ObjectID(p["oid"])
         view = self.store.get(oid)
+        if view is None and self._restore_local(oid):
+            view = self.store.get(oid)
         if view is None:
-            return None
+            data = self.store.read_spilled(oid)  # arena full: serve from disk
+            return None if data is None else {"size": len(data)}
         size = len(view)
         view.release()
         self.store.release(oid)
@@ -350,7 +378,12 @@ class NodeDaemon:
     def handle_read_object_chunk(self, conn, p):
         oid = ObjectID(p["oid"])
         view = self.store.get(oid)
+        if view is None and self._restore_local(oid):
+            view = self.store.get(oid)
         if view is None:
+            data = self.store.read_spilled(oid)
+            if data is not None:
+                return data[p["offset"] : p["offset"] + p["length"]]
             raise KeyError(f"object {oid.hex()} not in store")
         try:
             return bytes(view[p["offset"] : p["offset"] + p["length"]])
@@ -360,7 +393,7 @@ class NodeDaemon:
 
     def handle_delete_objects(self, conn, p):
         for oid_bin in p["oids"]:
-            self.store.delete(ObjectID(oid_bin))
+            self.store.delete(ObjectID(oid_bin), drop_spilled=True)
         return True
 
     def handle_report_sealed(self, conn, p):

@@ -48,6 +48,8 @@ class _Lib:
                 lib.store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
                 lib.store_evict.restype = ctypes.c_int
                 lib.store_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32]
+                lib.store_evict_candidates.restype = ctypes.c_int
+                lib.store_evict_candidates.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32]
                 for fn in ("store_capacity", "store_used", "store_num_objects"):
                     getattr(lib, fn).restype = ctypes.c_uint64
                     getattr(lib, fn).argtypes = [ctypes.c_void_p]
@@ -64,10 +66,20 @@ class ObjectExistsError(Exception):
 
 
 class SharedMemoryClient:
-    """Attach to (or create) a node's shm arena and do zero-copy object IO."""
+    """Attach to (or create) a node's shm arena and do zero-copy object IO.
 
-    def __init__(self, path: str, capacity: int | None = None, create: bool = False):
+    When ``spill_dir`` is set, allocation pressure spills LRU victims to disk
+    instead of dropping them (reference: raylet LocalObjectManager
+    /root/reference/src/ray/raylet/local_object_manager.h:109 spill /
+    AsyncRestoreSpilledObject:130). The spill directory is shared by every
+    process attached to the same arena (daemon + workers), so any of them can
+    restore; a spilled object's file name is its hex id, which makes the
+    directory self-describing with no extra index.
+    """
+
+    def __init__(self, path: str, capacity: int | None = None, create: bool = False, spill_dir: str | None = None):
         self.path = path
+        self.spill_dir = spill_dir if spill_dir is not None else path + "_spill"
         self._lib = _Lib.get()
         if create:
             if capacity is None:
@@ -101,13 +113,88 @@ class SharedMemoryClient:
             raise KeyError(f"seal: {oid.hex()} not in created state")
 
     def create_autoevict(self, oid: ObjectID, size: int) -> tuple[memoryview, list[ObjectID]]:
-        """create(), evicting LRU objects if needed. Returns (buffer, evicted
-        ids) — the caller must report evictions to the object directory."""
+        """create(), spilling (if a spill dir exists) or evicting LRU objects
+        as needed. Returns (buffer, evicted ids) — truly-evicted objects must
+        be reported to the object directory; spilled ones stay available on
+        this node and are NOT reported."""
         try:
             return self.create(oid, size), []
         except ObjectStoreFullError:
-            evicted = self.evict(size + (size >> 3))
+            need = size + (size >> 3)
+            spilled = self.spill(need)
+            if spilled:
+                try:
+                    return self.create(oid, size), []
+                except ObjectStoreFullError:
+                    pass
+            evicted = self.evict(need)
             return self.create(oid, size), evicted
+
+    # -- spilling -------------------------------------------------------
+    def spill(self, nbytes: int, max_ids: int = 4096) -> list[ObjectID]:
+        """Spill LRU victims to disk until ``nbytes`` would be free; victims
+        are deleted from the arena after their payload is durably on disk.
+        Returns the spilled ids. No-op (returns []) without a spill dir."""
+        if not self.spill_dir:
+            return []
+        buf = ctypes.create_string_buffer(_ID_SIZE * max_ids)
+        n = self._lib.store_evict_candidates(self._h, nbytes, buf, max_ids)
+        if n <= 0:
+            return []
+        os.makedirs(self.spill_dir, exist_ok=True)
+        spilled = []
+        for i in range(n):
+            oid = ObjectID(buf.raw[i * _ID_SIZE : (i + 1) * _ID_SIZE])
+            view = self.get(oid)  # pins; skips objects deleted meanwhile
+            if view is None:
+                continue
+            path = os.path.join(self.spill_dir, oid.hex())
+            try:
+                tmp = f"{path}.tmp{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(view)
+                os.replace(tmp, path)
+            finally:
+                view.release()
+                self.release(oid)
+            self.delete(oid)
+            spilled.append(oid)
+        return spilled
+
+    def restore(self, oid: ObjectID, evicted_out: list | None = None) -> bool:
+        """Copy a spilled object back into the arena (idempotent; safe under
+        concurrent restores from several processes). The spill file is kept
+        until the object is deleted, so repeated pressure re-spills cheaply.
+
+        Any ids truly evicted to make room are appended to ``evicted_out`` —
+        the caller must report them to the object directory like every other
+        create_autoevict caller. Returns False (without raising) when the
+        arena cannot fit the object right now; use read_spilled() then."""
+        data = self.read_spilled(oid)
+        if data is None:
+            return False
+        try:
+            evicted = self.put(oid, data)
+            if evicted_out is not None:
+                evicted_out.extend(evicted)
+        except ObjectExistsError:
+            pass  # another process restored it first
+        except ObjectStoreFullError:
+            return False  # remaining residents pinned; payload stays on disk
+        return True
+
+    def read_spilled(self, oid: ObjectID) -> Optional[bytes]:
+        """Read a spilled payload straight off disk (no arena allocation)."""
+        if not self.spill_dir:
+            return None
+        try:
+            with open(os.path.join(self.spill_dir, oid.hex()), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def is_spilled(self, oid: ObjectID) -> bool:
+        return bool(self.spill_dir) and os.path.exists(os.path.join(self.spill_dir, oid.hex()))
 
     def put(self, oid: ObjectID, data: bytes | memoryview) -> list[ObjectID]:
         buf, evicted = self.create_autoevict(oid, len(data))
@@ -141,8 +228,18 @@ class SharedMemoryClient:
     def contains(self, oid: ObjectID) -> bool:
         return bool(self._lib.store_contains(self._h, oid.binary()))
 
-    def delete(self, oid: ObjectID) -> bool:
-        return self._lib.store_delete(self._h, oid.binary()) == 0
+    def contains_or_spilled(self, oid: ObjectID) -> bool:
+        return self.contains(oid) or self.is_spilled(oid)
+
+    def delete(self, oid: ObjectID, drop_spilled: bool = False) -> bool:
+        ok = self._lib.store_delete(self._h, oid.binary()) == 0
+        if drop_spilled and self.spill_dir:
+            try:
+                os.unlink(os.path.join(self.spill_dir, oid.hex()))
+                ok = True
+            except OSError:
+                pass
+        return ok
 
     def evict(self, nbytes: int, max_ids: int = 4096) -> list[ObjectID]:
         buf = ctypes.create_string_buffer(_ID_SIZE * max_ids)

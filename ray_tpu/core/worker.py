@@ -223,6 +223,16 @@ class CoreWorker:
         # (reference: ReferenceCounter "submitted task references",
         # reference_counter.h:44).
         self._inflight_deps: dict[bytes, list] = {}
+        # Lineage: specs (+ pinned dep refs) of finished normal tasks whose
+        # shm-resident returns may need re-execution if every copy is lost
+        # (reference: TaskManager lineage, task_manager.h:184-217; capped by
+        # lineage_max_bytes with oldest-first eviction).
+        self._lineage: dict[bytes, tuple[TaskSpec, list, int]] = {}
+        self._lineage_bytes = 0
+        # In-flight recoveries, one future per object so concurrent getters
+        # coalesce (reference: ObjectRecoveryManager idempotent per-object ops,
+        # object_recovery_manager.h:62-76).
+        self._recovering: dict[bytes, asyncio.Future] = {}
         self._bg: list[asyncio.Task] = []
         self.task_events: list[dict] = []  # per-task event buffer (task_event_buffer.h equiv)
         self._current_task: Optional[TaskSpec] = None
@@ -265,7 +275,7 @@ class CoreWorker:
             info = node_info["nodes"].get(self.node_id)
             store_path = info["store_path"] if info else ""
         if store_path and os.path.exists(store_path):
-            self.store = SharedMemoryClient(store_path)
+            self.store = SharedMemoryClient(store_path, spill_dir=self.config.object_spill_dir or None)
         if self.mode == "worker":
             reply = await self.daemon.call("register_worker", {"worker_id": self.worker_id, "address": self.address})
             self.node_id = reply["node_id"]
@@ -413,6 +423,22 @@ class CoreWorker:
             self.memory_store.delete(oid)
             if rec.in_shm:
                 asyncio.create_task(self._free_remote(oid))
+            self._maybe_release_lineage(oid)
+
+    def _maybe_release_lineage(self, oid: ObjectID):
+        """Drop a task's lineage once none of its returns are referenced
+        (reference: ReferenceCounter-driven lineage release)."""
+        if oid.is_put():
+            return
+        tid = oid.task_id()
+        entry = self._lineage.get(tid.binary())
+        if entry is None:
+            return
+        spec, _deps, cost = entry
+        if any(ObjectID.for_return(tid, i) in self.owned for i in range(spec.num_returns)):
+            return
+        del self._lineage[tid.binary()]
+        self._lineage_bytes -= cost
 
     async def _free_remote(self, oid: ObjectID):
         try:
@@ -542,7 +568,113 @@ class CoreWorker:
             data = self._read_shm(oid)
             if data is not None:
                 return self._deserialize_value(data)
+        # 6. every copy is gone: recover via lineage re-execution (owner-side;
+        # borrowers ask the owner) — reference: ObjectRecoveryManager
+        # (object_recovery_manager.h:41) + TaskManager resubmit (task_manager.h:184).
+        if _depth < 3 and await self._try_recover(ref):
+            return await self._get_one(ref, _depth + 1)
         raise ObjectLostError(f"object {oid.hex()} is unavailable (owner {ref.owner_addr} unreachable or value lost)")
+
+    async def _ensure_dep_available(self, d) -> None:
+        """Best-effort: make sure a dependency's payload exists somewhere in
+        the cluster, recovering it via its owner if every copy is gone."""
+        if not isinstance(d, ObjectRef):
+            return
+        oid = d.id
+        if self.memory_store.contains(oid):
+            return
+        rec = self.owned.get(oid) if d.owner_addr == self.address else None
+        if rec is not None and rec.in_memory:
+            return
+        if self.store is not None and self.store.contains_or_spilled(oid):
+            return
+        locs = await self.controller.call("lookup_object", {"oid": oid.binary()})
+        if locs:
+            return
+        await self._try_recover(d)
+
+    async def _try_recover(self, ref: ObjectRef) -> bool:
+        if ref.owner_addr == self.address:
+            return await self._recover_object(ref.id)
+        if ref.owner_addr:
+            try:
+                conn = await self._peer_conn(ref.owner_addr)
+                return bool(await conn.call("recover_object", {"oid": ref.id.binary()}))
+            except Exception:
+                return False
+        return False
+
+    async def handle_recover_object(self, conn, p):
+        return await self._recover_object(ObjectID(p["oid"]))
+
+    async def _recover_object(self, oid: ObjectID) -> bool:
+        key = oid.binary()
+        pending = self._recovering.get(key)
+        if pending is not None:  # coalesce concurrent recoveries of one object
+            return await asyncio.shield(pending)
+        fut = asyncio.get_running_loop().create_future()
+        self._recovering[key] = fut
+        ok = False
+        try:
+            ok = await self._recover_impl(oid)
+        except Exception as e:
+            logger.warning("recovery of %s failed: %s", oid.hex()[:10], e)
+        finally:
+            # Resolve the future even on cancellation (e.g. a get() timeout
+            # cancels this coroutine) or coalesced waiters hang forever.
+            self._recovering.pop(key, None)
+            if not fut.done():
+                fut.set_result(ok)
+        return ok
+
+    async def _recover_impl(self, oid: ObjectID) -> bool:
+        # Copy-hunting first: a surviving replica beats re-execution
+        # (object_recovery_manager.h:62 pins other copies before lineage).
+        if self.store is not None and await self._pull_to_local(oid) and self.store.contains_or_spilled(oid):
+            return True
+        if oid.is_put():
+            return False  # ray.put objects have no producing task
+        entry = self._lineage.get(oid.task_id().binary())
+        if entry is None:
+            return False
+        spec, deps, _cost = entry
+        retries = spec.options.max_retries
+        if retries == -1:
+            retries = self.config.max_task_retries_default
+        attempts = getattr(spec, "_recoveries", 0)
+        if attempts >= retries:  # max_retries=0 => never re-execute (non-idempotent task)
+            return False
+        spec._recoveries = attempts + 1  # type: ignore[attr-defined]
+        # Flip every return of the task back to PENDING so getters re-block on
+        # a fresh event while the task re-executes.
+        for i in range(spec.num_returns):
+            rec = self.owned.get(ObjectID.for_return(spec.task_id, i))
+            if rec is not None:
+                rec.state = "PENDING"
+                rec.ready_event = asyncio.Event()
+        logger.warning(
+            "object %s lost; re-executing task %s from lineage (attempt %d)",
+            oid.hex()[:10],
+            spec.task_id.hex()[:8],
+            attempts + 1,
+        )
+        self._event("object_recovery", oid=oid.hex(), task_id=spec.task_id.hex())
+        # Reconstruct lost dependencies bottom-up BEFORE resubmitting: the
+        # re-executed task would otherwise discover the loss mid-execution
+        # while holding its resources — deadlock when the dep's re-execution
+        # needs those same resources (the reference resolves/pulls args before
+        # the lease grant for the same reason, dependency_resolver.h).
+        for d in deps:
+            try:
+                await self._ensure_dep_available(d)
+            except Exception:
+                pass
+        await self._submit(spec, list(deps))
+        rec = self.owned.get(oid)
+        if rec is None:
+            return False
+        await rec.ready_event.wait()
+        return rec.state == "READY"
 
     def _read_shm(self, oid: ObjectID) -> bytes | None:
         """Read an object payload out of the shared-memory arena.
@@ -555,6 +687,18 @@ class CoreWorker:
         if self.store is None:
             return None
         view = self.store.get(oid)
+        if view is None:  # spilled? restore (or read straight off disk if full)
+            evicted: list = []
+            restored = self.store.restore(oid, evicted_out=evicted)
+            if evicted:
+                try:
+                    asyncio.get_running_loop().create_task(self._report_evicted(evicted))
+                except RuntimeError:
+                    pass
+            if restored:
+                view = self.store.get(oid)
+            else:
+                return self.store.read_spilled(oid)
         if view is None:
             return None
         try:
@@ -602,7 +746,7 @@ class CoreWorker:
         oid = ObjectID(p["oid"])
         rec = self.owned.get(oid)
         if rec is None:
-            return self.memory_store.contains(oid) or (self.store is not None and self.store.contains(oid))
+            return self.memory_store.contains(oid) or (self.store is not None and self.store.contains_or_spilled(oid))
         if rec.state == "PENDING":
             try:
                 await asyncio.wait_for(rec.ready_event.wait(), timeout=p.get("timeout", 30.0))
@@ -626,7 +770,7 @@ class CoreWorker:
             rec = self.owned.get(r.id)
             if rec is not None and r.owner_addr == self.address:
                 return rec.state != "PENDING"
-            if self.store is not None and self.store.contains(r.id):
+            if self.store is not None and self.store.contains_or_spilled(r.id):
                 return True
             if r.owner_addr and r.owner_addr != self.address:
                 try:
@@ -730,9 +874,21 @@ class CoreWorker:
                 except Exception:
                     pass
 
+    def _add_lineage(self, spec: TaskSpec, deps: list):
+        key = spec.task_id.binary()
+        if key in self._lineage:
+            return
+        cost = len(spec.args_blob) + 256
+        self._lineage[key] = (spec, deps, cost)
+        self._lineage_bytes += cost
+        while self._lineage_bytes > self.config.lineage_max_bytes and self._lineage:
+            k = next(iter(self._lineage))
+            _, _, c = self._lineage.pop(k)
+            self._lineage_bytes -= c
+
     def _absorb_task_reply(self, spec: TaskSpec, reply: dict, fut: asyncio.Future | None = None):
         """Record task return values from a push_task reply."""
-        self._inflight_deps.pop(spec.task_id.binary(), None)
+        deps = self._inflight_deps.pop(spec.task_id.binary(), None)
         self._event("task_finished", task_id=spec.task_id.hex(), status=reply.get("status"))
         if reply.get("status") == "error":
             err: BaseException = reply.get("error") or RemoteError("task failed")
@@ -742,7 +898,13 @@ class CoreWorker:
             if fut is not None and not fut.done():
                 fut.set_result(False)
             return
-        for i, item in enumerate(reply.get("returns", [])):
+        returns = reply.get("returns", [])
+        # Shm returns can be lost (eviction, node death): retain the spec for
+        # lineage re-execution. Inline returns live in the owner's memory
+        # store and die with the owner, which lineage cannot help anyway.
+        if any(item.get("inline") is None for item in returns) and spec.actor_id is None:
+            self._add_lineage(spec, deps or [])
+        for i, item in enumerate(returns):
             oid = ObjectID.for_return(spec.task_id, i)
             if item.get("inline") is not None:
                 self.memory_store.put(oid, item["inline"])

@@ -1,0 +1,165 @@
+"""Object spilling and lineage reconstruction.
+
+Reference analogues: python/ray/tests/test_object_spilling*.py (spill under
+store pressure, restore on get) and test_reconstruction*.py (lost objects
+re-created by re-executing the producing task — task_manager.h:184,
+object_recovery_manager.h:41).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import SharedMemoryClient
+
+
+# ---------------------------------------------------------------- spilling
+
+
+def test_store_spill_and_restore(tmp_path):
+    s = SharedMemoryClient(
+        str(tmp_path / "store"), capacity=4 * 1024 * 1024, create=True, spill_dir=str(tmp_path / "spill")
+    )
+    blobs = {}
+    for _ in range(12):  # 12 * 700KB ≈ 2x capacity
+        oid = ObjectID.from_put()
+        data = os.urandom(700 * 1024)
+        s.put(oid, data)
+        blobs[oid] = data
+    # Everything is still retrievable: resident or restored from disk.
+    for oid, data in blobs.items():
+        if not s.contains(oid):
+            assert s.is_spilled(oid)
+            assert s.restore(oid)
+        assert s.get_copy(oid) == data
+    s.close()
+
+
+def test_store_spill_delete_drops_file(tmp_path):
+    s = SharedMemoryClient(
+        str(tmp_path / "store"), capacity=1024 * 1024, create=True, spill_dir=str(tmp_path / "spill")
+    )
+    a = ObjectID.from_put()
+    s.put(a, os.urandom(700 * 1024))
+    s.put(ObjectID.from_put(), os.urandom(700 * 1024))  # pressure -> a spills
+    assert s.is_spilled(a)
+    s.delete(a, drop_spilled=True)
+    assert not s.is_spilled(a)
+    assert not s.contains_or_spilled(a)
+    s.close()
+
+
+def test_spill_integration_10x_capacity():
+    """Fill the store ~10x over capacity through the public API; every object
+    must come back (reference: test_object_spilling.py fill-beyond-capacity)."""
+    from ray_tpu.core.api import Cluster, init, shutdown
+
+    cluster = Cluster(initialize_head=False)
+    cluster.add_node(num_cpus=2, object_store_memory=16 * 1024 * 1024)
+    init(address=cluster.address)
+    try:
+        arrays = [np.full(1_000_000, i, dtype=np.float64) for i in range(20)]  # 20 x 8MB = 160MB
+        refs = [rt.put(a) for a in arrays]
+        for i, ref in enumerate(refs):
+            got = rt.get(ref, timeout=60)
+            assert got[0] == float(i) and got.shape == (1_000_000,)
+    finally:
+        shutdown()
+        cluster.shutdown()
+
+
+# ------------------------------------------------- lineage reconstruction
+
+
+@pytest.fixture
+def recovery_cluster():
+    from ray_tpu.core.api import Cluster, init, shutdown
+
+    cluster = Cluster(initialize_head=False)
+    head = cluster.add_node(num_cpus=2)
+    init(address=cluster.address)
+    yield cluster
+    shutdown()
+    cluster.shutdown()
+
+
+def _exec_marker_dir(tmp_path):
+    d = str(tmp_path / "exec_markers")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def test_lost_object_reexecuted(recovery_cluster, tmp_path):
+    cluster = recovery_cluster
+    marker_dir = _exec_marker_dir(tmp_path)
+    victim = cluster.add_node(num_cpus=2, resources={"special": 1.0})
+
+    @rt.remote(resources={"special": 1.0}, max_retries=2)
+    def make():
+        with open(os.path.join(marker_dir, os.urandom(6).hex()), "w"):
+            pass
+        return np.arange(500_000, dtype=np.float64)  # 4MB -> shm on the special node
+
+    ref = make.remote()
+    ready, _ = rt.wait([ref], timeout=60)  # completes WITHOUT pulling payload to the driver node
+    assert ready
+    assert len(os.listdir(marker_dir)) == 1
+    # Kill the only node holding the payload; bring up a replacement so the
+    # re-executed task is feasible.
+    cluster.remove_node(victim)
+    cluster.add_node(num_cpus=2, resources={"special": 1.0})
+    got = rt.get(ref, timeout=120)
+    assert got.shape == (500_000,) and got[-1] == 499_999.0
+    assert len(os.listdir(marker_dir)) == 2  # really re-executed
+
+
+def test_lineage_chain_recovers_dependencies(recovery_cluster, tmp_path):
+    cluster = recovery_cluster
+    marker_dir = _exec_marker_dir(tmp_path)
+    victim = cluster.add_node(num_cpus=2, resources={"special": 1.0})
+
+    @rt.remote(resources={"special": 1.0}, max_retries=2)
+    def produce():
+        with open(os.path.join(marker_dir, "p_" + os.urandom(6).hex()), "w"):
+            pass
+        return np.ones(400_000, dtype=np.float64)
+
+    @rt.remote(resources={"special": 1.0}, max_retries=2)
+    def double(a):
+        with open(os.path.join(marker_dir, "d_" + os.urandom(6).hex()), "w"):
+            pass
+        return a * 2.0
+
+    a = produce.remote()
+    b = double.remote(a)
+    ready, _ = rt.wait([b], timeout=60)
+    assert ready
+    cluster.remove_node(victim)
+    cluster.add_node(num_cpus=2, resources={"special": 1.0})
+    got = rt.get(b, timeout=120)
+    assert got[0] == 2.0
+    # double re-ran; its dependency `a` was itself recovered via lineage.
+    markers = os.listdir(marker_dir)
+    assert sum(m.startswith("d_") for m in markers) == 2
+    assert sum(m.startswith("p_") for m in markers) == 2
+
+
+def test_no_recovery_when_retries_disabled(recovery_cluster, tmp_path):
+    cluster = recovery_cluster
+    victim = cluster.add_node(num_cpus=2, resources={"special": 1.0})
+
+    @rt.remote(resources={"special": 1.0}, max_retries=0)
+    def make():
+        return np.zeros(400_000, dtype=np.float64)
+
+    ref = make.remote()
+    ready, _ = rt.wait([ref], timeout=60)
+    assert ready
+    cluster.remove_node(victim)
+    cluster.add_node(num_cpus=2, resources={"special": 1.0})
+    from ray_tpu.core.object_ref import ObjectLostError
+
+    with pytest.raises(ObjectLostError):
+        rt.get(ref, timeout=30)
