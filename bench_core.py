@@ -1,0 +1,251 @@
+"""Core-runtime microbenchmarks, mirroring the reference's release
+microbenchmark suite (/root/reference/release/microbenchmark — results in
+release/perf_metrics/microbenchmark.json, copied to BASELINE.md).
+
+Prints one JSON line per row: {"metric": ..., "value": ..., "unit": ...,
+"baseline": <m5.16xlarge number>, "vs_baseline": ...}. The baseline hardware
+is a 64-core m5.16xlarge; this environment typically has 1 core, so
+vs_baseline is a lower bound on per-core parity.
+
+Run: python bench_core.py [--quick]
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import ray_tpu as rt
+
+QUICK = "--quick" in sys.argv
+SCALE = 0.2 if QUICK else 1.0
+
+# m5.16xlarge numbers from BASELINE.md (release/perf_metrics/microbenchmark.json).
+BASELINES = {
+    "1_1_actor_calls_sync": 1989.7,
+    "1_1_actor_calls_async": 8591.5,
+    "n_n_actor_calls_async": 22593.7,
+    "1_1_async_actor_calls_sync": 1433.5,
+    "1_1_async_actor_calls_async": 3853.3,
+    "single_client_tasks_sync": 844.7,
+    "single_client_tasks_async": 6769.6,
+    "single_client_get_calls": 9361.1,
+    "single_client_put_calls": 4116.4,
+    "single_client_put_gigabytes": 18.2,
+    "single_client_wait_1k_refs": 4.72,
+    "placement_group_create_removal": 678.9,
+}
+
+RESULTS = []
+
+
+def report(metric: str, ops: float, elapsed: float, unit: str = "ops/s"):
+    value = ops / elapsed
+    base = BASELINES.get(metric)
+    row = {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "baseline": base,
+        "vs_baseline": round(value / base, 3) if base else None,
+    }
+    RESULTS.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def settle():
+    """Drain the IO loop's callback backlog from the previous bench so its
+    cost doesn't bleed into the next measurement (submissions are one-way
+    fast-path callbacks; a wait() forces a full loop round trip)."""
+    ref = rt.put(b"settle")
+    rt.wait([ref], num_returns=1, timeout=10)
+    time.sleep(0.1)
+
+
+def timed(fn, n: int) -> float:
+    settle()
+    t0 = time.perf_counter()
+    fn(n)
+    return time.perf_counter() - t0
+
+
+@rt.remote
+class Sink:
+    def ping(self):
+        return b"ok"
+
+    def with_arg(self, x):
+        return b"ok"
+
+
+@rt.remote(max_concurrency=64)
+class AsyncSink:
+    async def ping(self):
+        return b"ok"
+
+
+@rt.remote
+def noop():
+    return b"ok"
+
+
+def bench_actor_sync(n):
+    a = Sink.remote()
+    rt.get(a.ping.remote(), timeout=60)
+
+    def run(k):
+        for _ in range(k):
+            rt.get(a.ping.remote(), timeout=60)
+
+    report("1_1_actor_calls_sync", n, timed(run, n))
+
+
+def bench_actor_async(n):
+    a = Sink.remote()
+    rt.get(a.ping.remote(), timeout=60)
+
+    def run(k):
+        rt.get([a.ping.remote() for _ in range(k)], timeout=120)
+
+    report("1_1_actor_calls_async", n, timed(run, n))
+
+
+def bench_actor_nn_async(n):
+    actors = [Sink.remote() for _ in range(4)]
+    rt.get([a.ping.remote() for a in actors], timeout=60)
+
+    def run(k):
+        refs = [actors[i % len(actors)].ping.remote() for i in range(k)]
+        rt.get(refs, timeout=120)
+
+    report("n_n_actor_calls_async", n, timed(run, n))
+
+
+def bench_async_actor_sync(n):
+    a = AsyncSink.remote()
+    rt.get(a.ping.remote(), timeout=60)
+
+    def run(k):
+        for _ in range(k):
+            rt.get(a.ping.remote(), timeout=60)
+
+    report("1_1_async_actor_calls_sync", n, timed(run, n))
+
+
+def bench_async_actor_async(n):
+    a = AsyncSink.remote()
+    rt.get(a.ping.remote(), timeout=60)
+
+    def run(k):
+        rt.get([a.ping.remote() for _ in range(k)], timeout=120)
+
+    report("1_1_async_actor_calls_async", n, timed(run, n))
+
+
+def bench_tasks_sync(n):
+    rt.get(noop.remote(), timeout=60)
+
+    def run(k):
+        for _ in range(k):
+            rt.get(noop.remote(), timeout=60)
+
+    report("single_client_tasks_sync", n, timed(run, n))
+
+
+def bench_tasks_async(n):
+    rt.get(noop.remote(), timeout=60)
+
+    def run(k):
+        rt.get([noop.remote() for _ in range(k)], timeout=300)
+
+    report("single_client_tasks_async", n, timed(run, n))
+
+
+def bench_get_calls(n):
+    ref = rt.put(b"x" * 1024)
+
+    def run(k):
+        for _ in range(k):
+            rt.get(ref, timeout=60)
+
+    report("single_client_get_calls", n, timed(run, n))
+
+
+def bench_put_calls(n):
+    def run(k):
+        for _ in range(k):
+            rt.put(b"x" * 1024)
+
+    report("single_client_put_calls", n, timed(run, n))
+
+
+def bench_put_gigabytes(n_bytes):
+    chunk = 64 * 1024 * 1024
+    # ndarray payload: rides the protocol-5 out-of-band buffer path, so the
+    # put is one scatter memcpy into shared memory (the realistic tensor case).
+    data = np.ones(chunk, dtype=np.uint8)
+    reps = max(1, n_bytes // chunk)
+    refs = []
+
+    def run(k):
+        for _ in range(k):
+            refs.append(rt.put(data))
+
+    elapsed = timed(run, reps)
+    report("single_client_put_gigabytes", reps * chunk / 1e9, elapsed, unit="GB/s")
+    del refs
+
+
+def bench_wait_1k_refs(n_rounds):
+    refs = [rt.put(i) for i in range(1000)]
+
+    def run(k):
+        for _ in range(k):
+            rt.wait(refs, num_returns=len(refs), timeout=120)
+
+    report("single_client_wait_1k_refs", n_rounds, timed(run, n_rounds))
+
+
+def bench_pg_create_removal(n):
+    def run(k):
+        for _ in range(k):
+            pg = rt.placement_group([{"CPU": 0.001}], strategy="PACK")
+            pg.ready(timeout=30)
+            rt.remove_placement_group(pg)
+
+    report("placement_group_create_removal", n, timed(run, n))
+
+
+def main():
+    # Each bench runs in a fresh session (the reference's microbenchmark suite
+    # re-inits Ray per benchmark the same way): on a small host, worker
+    # processes left by a previous bench would otherwise steal cycles from
+    # the next measurement.
+    benches = [
+        (bench_actor_sync, int(1000 * SCALE)),
+        (bench_actor_async, int(3000 * SCALE)),
+        (bench_actor_nn_async, int(3000 * SCALE)),
+        (bench_async_actor_sync, int(1000 * SCALE)),
+        (bench_async_actor_async, int(3000 * SCALE)),
+        (bench_tasks_sync, int(500 * SCALE)),
+        (bench_tasks_async, int(2000 * SCALE)),
+        (bench_get_calls, int(3000 * SCALE)),
+        (bench_put_calls, int(3000 * SCALE)),
+        (bench_put_gigabytes, int(512 * 1024 * 1024 * SCALE)),
+        (bench_wait_1k_refs, max(1, int(5 * SCALE))),
+        (bench_pg_create_removal, int(200 * SCALE)),
+    ]
+    for fn, n in benches:
+        rt.init(num_cpus=16, object_store_memory=512 * 1024 * 1024)
+        try:
+            fn(n)
+        finally:
+            rt.shutdown()
+    with open("BENCH_CORE.json", "w") as f:
+        json.dump(RESULTS, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

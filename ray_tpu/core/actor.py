@@ -73,8 +73,11 @@ class ActorClass:
         from ray_tpu.core import api
 
         core = api._require_worker()
-        if self._cls_id is None:
+        # Re-export if the session changed (a new driver/controller has a
+        # fresh KV; a cached id from a previous session would dangle).
+        if self._cls_id is None or getattr(self, "_cls_session", None) is not core:
             self._cls_id = core.export_callable("cls", self._cls)
+            self._cls_session = core
         blob, _ = serialization.serialize((args, kwargs))
         opts = replace(self._opts)
         actor_id = core.create_actor_sync(

@@ -138,6 +138,7 @@ class Controller:
         self.pgs: dict[PlacementGroupID, PGRecord] = {}
         self.leases: dict[str, tuple[str, dict, Any, Any]] = {}  # lease_id -> (node_id, demand, strategy, owner_conn)
         self.pending_leases: list[PendingLease] = []
+        self.pending_actors: list = []  # ActorRecords parked until placeable
         self.object_dir: dict[bytes, set[str]] = {}  # oid bytes -> node ids
         self.object_sizes: dict[bytes, int] = {}
         self.subscribers: dict[str, set] = {}  # channel -> conns
@@ -495,9 +496,17 @@ class Controller:
             asyncio.create_task(self._retry_pending())
 
     async def _retry_pending(self):
-        granted = True
-        while granted and self.pending_leases:
-            granted = False
+        """Event-driven reconciliation of ALL pending work (leases, PGs,
+        actors); called whenever capacity changes (lease release, node join,
+        worker death, PG removal) rather than on a poll timer."""
+        progress = True
+        while progress:
+            progress = False
+            for pg in [g for g in self.pgs.values() if g.state == "PENDING"]:
+                assignment = self._plan_bundles(pg)
+                if assignment is not None:
+                    self._commit_pg(pg, assignment)
+                    progress = True
             for pl in list(self.pending_leases):
                 node = self._pick_node(pl.demand, pl.strategy, pl.label_selector)
                 if node is not None:
@@ -508,7 +517,21 @@ class Controller:
                         pl.future.set_result(
                             {"node_id": node.node_id, "address": node.address, "store_path": node.store_path, "strategy": pl.strategy}
                         )
-                    granted = True
+                    progress = True
+            for record in list(self.pending_actors):
+                if record.state == DEAD:
+                    self.pending_actors.remove(record)
+                    continue
+                spec = record.spec
+                node = self._pick_node(spec.options.resource_demand(), spec.options.scheduling_strategy, spec.options.label_selector)
+                if node is not None:
+                    self.pending_actors.remove(record)
+                    # Consume synchronously BEFORE yielding to the created
+                    # task, or the same free capacity double-books across
+                    # actors/leases examined later in this pass.
+                    self._consume(node, spec.options.resource_demand(), spec.options.scheduling_strategy)
+                    asyncio.create_task(self._start_actor_on(record, node))
+                    progress = True
 
     # -- actors ---------------------------------------------------------
     async def handle_register_actor(self, conn, p):
@@ -558,45 +581,56 @@ class Controller:
         self.publish("actor", record.actor_id.hex(), info)
 
     async def _schedule_actor(self, record: ActorRecord):
+        """Place the actor now if possible, else park it PENDING indefinitely —
+        a node may join later (reference: GcsActorManager keeps actors
+        PENDING_CREATION without a deadline, gcs_actor_manager.h FSM). Waking
+        is event-driven via _retry_pending, not a poll."""
+        if record.state == DEAD:
+            return  # killed while pending; don't resurrect
+        spec = record.spec
+        node = self._pick_node(spec.options.resource_demand(), spec.options.scheduling_strategy, spec.options.label_selector)
+        if node is None:
+            if record not in self.pending_actors:
+                self.pending_actors.append(record)
+            return
+        self._consume(node, spec.options.resource_demand(), spec.options.scheduling_strategy)
+        await self._start_actor_on(record, node)
+
+    async def _start_actor_on(self, record: ActorRecord, node: NodeRecord):
+        """Start a (already resource-consumed) actor on the chosen node."""
         spec = record.spec
         demand = spec.options.resource_demand()
         strategy = spec.options.scheduling_strategy
-        deadline = time.monotonic() + self.config.actor_creation_timeout_s
-        while time.monotonic() < deadline:
-            if record.state == DEAD:
-                return  # killed while pending; don't resurrect
-            node = self._pick_node(demand, strategy, spec.options.label_selector)
-            if node is None:
-                # Stay pending while demand is (even permanently) unsatisfied —
-                # a node may join; the reference likewise parks actors as
-                # PENDING_CREATION and only warns (gcs_actor_manager.h FSM).
-                await asyncio.sleep(0.05)
-                continue
-            self._consume(node, demand, strategy)
-            record.node_id = node.node_id
-            try:
-                reply = await node.conn.call("start_actor", {"spec": spec}, timeout=self.config.actor_creation_timeout_s)
-                if record.state == DEAD:  # killed during creation
-                    self._restore(node.node_id, demand, strategy)
-                    try:
-                        await node.conn.call("kill_worker", {"worker_id": reply["worker_id"], "reason": "actor killed"}, timeout=5)
-                    except Exception:
-                        pass
-                    return
-                record.worker_addr = reply["worker_addr"]
-                record.worker_id = reply["worker_id"]
-                record.state = ALIVE
-                self._event("actor_alive", actor_id=record.actor_id.hex(), node=node.node_id)
+        record.node_id = node.node_id
+        record.creation_attempts = getattr(record, "creation_attempts", 0) + 1
+        try:
+            reply = await node.conn.call("start_actor", {"spec": spec}, timeout=self.config.actor_creation_timeout_s)
+            if record.state == DEAD:  # killed during creation
+                self._restore(node.node_id, demand, strategy)
+                try:
+                    await node.conn.call("kill_worker", {"worker_id": reply["worker_id"], "reason": "actor killed"}, timeout=5)
+                except Exception:
+                    pass
+                return
+            record.worker_addr = reply["worker_addr"]
+            record.worker_id = reply["worker_id"]
+            record.state = ALIVE
+            record.creation_attempts = 0  # only CONSECUTIVE failures are terminal
+            self._event("actor_alive", actor_id=record.actor_id.hex(), node=node.node_id)
+            self._wake_actor_waiters(record)
+        except Exception as e:
+            self._restore(node.node_id, demand, strategy)
+            record.node_id = ""
+            logger.warning("actor %s creation on %s failed: %s", record.actor_id.hex()[:8], node.node_id[:8], e)
+            if record.creation_attempts >= 3:
+                # Repeated *creation* failures (constructor raising, node
+                # flapping) are terminal — different from unplaceable-pending.
+                record.state = DEAD
+                record.death_cause = f"actor creation failed {record.creation_attempts} times: {e}"
                 self._wake_actor_waiters(record)
                 return
-            except Exception as e:
-                self._restore(node.node_id, demand, strategy)
-                record.node_id = ""
-                logger.warning("actor %s creation on %s failed: %s", record.actor_id.hex()[:8], node.node_id[:8], e)
-                await asyncio.sleep(0.1)
-        record.state = DEAD
-        record.death_cause = "actor creation timed out"
-        self._wake_actor_waiters(record)
+            await asyncio.sleep(self.config.task_retry_delay_s)
+            await self._schedule_actor(record)
 
     async def _on_actor_worker_died(self, record: ActorRecord, reason: str):
         if record.state == DEAD:
@@ -712,12 +746,19 @@ class Controller:
     async def _schedule_pg(self, pg: PGRecord):
         """Gang-reserve all bundles atomically on the central ledger
         (reference: GcsPlacementGroupScheduler 2PC across raylets,
-        bundle_scheduling_policy.h:73-97 for PACK/SPREAD/STRICT_*)."""
+        bundle_scheduling_policy.h:73-97 for PACK/SPREAD/STRICT_*). An
+        unplaceable PG stays PENDING; _retry_pending commits it when capacity
+        appears (event-driven, no poll loop)."""
         assignment = self._plan_bundles(pg)
         if assignment is None:
             pg.state = "PENDING"
-            asyncio.create_task(self._pg_retry_loop(pg))
             return
+        self._commit_pg(pg, assignment)
+        # Leases queued with PLACEMENT_GROUP strategy were unschedulable until
+        # now — wake them.
+        await self._retry_pending()
+
+    def _commit_pg(self, pg: PGRecord, assignment: list):
         for b, node in zip(pg.bundles, assignment):
             _sub(node.resources_available, b.resources)
             b.node_id = node.node_id
@@ -728,9 +769,6 @@ class Controller:
             if not fut.done():
                 fut.set_result({"state": "CREATED", "bundle_nodes": [b.node_id for b in pg.bundles]})
         pg.pending_waiters.clear()
-        # Leases queued with PLACEMENT_GROUP strategy were unschedulable until
-        # now — wake them.
-        await self._retry_pending()
 
     def _plan_bundles(self, pg: PGRecord) -> Optional[list]:
         nodes = [n for n in self.nodes.values() if n.state == "ALIVE"]
@@ -765,14 +803,25 @@ class Controller:
             assignment.append(byid[pick.node_id])
         return assignment
 
-    async def _pg_retry_loop(self, pg: PGRecord):
-        while pg.state == "PENDING" and pg.pg_id in self.pgs:
-            await asyncio.sleep(0.2)
-            if pg.state == "PENDING":
-                assignment = self._plan_bundles(pg)
-                if assignment is not None:
-                    await self._schedule_pg(pg)
-                    return
+    async def handle_wait_placement_group(self, conn, p):
+        """Block until the PG is CREATED or REMOVED (event-driven client
+        ready(); replaces client-side polling)."""
+        pg = self.pgs.get(p["pg_id"])
+        if pg is None:
+            return {"state": "REMOVED"}
+        if pg.state == "CREATED":
+            return {"state": "CREATED", "bundle_nodes": [b.node_id for b in pg.bundles]}
+        fut = asyncio.get_running_loop().create_future()
+        pg.pending_waiters.append(fut)
+        timeout = p.get("timeout")
+        if timeout is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), timeout)
+        except asyncio.TimeoutError:
+            if fut in pg.pending_waiters:
+                pg.pending_waiters.remove(fut)
+            return {"state": pg.state}
 
     async def handle_remove_placement_group(self, conn, p):
         pg = self.pgs.get(p["pg_id"])
@@ -789,6 +838,10 @@ class Controller:
                     _add(node.resources_available, b.resources)
         pg.state = "REMOVED"
         self.pgs.pop(pg.pg_id, None)
+        for fut in pg.pending_waiters:
+            if not fut.done():
+                fut.set_result({"state": "REMOVED"})
+        pg.pending_waiters.clear()
         self._event("pg_removed", pg_id=pg.pg_id.hex())
         await self._retry_pending()
 

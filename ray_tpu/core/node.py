@@ -368,8 +368,8 @@ class NodeDaemon:
         if view is None and self._restore_local(oid):
             view = self.store.get(oid)
         if view is None:
-            data = self.store.read_spilled(oid)  # arena full: serve from disk
-            return None if data is None else {"size": len(data)}
+            size = self.store.spilled_size(oid)  # arena full: serve from disk
+            return None if size is None else {"size": size}
         size = len(view)
         view.release()
         self.store.release(oid)
@@ -381,9 +381,9 @@ class NodeDaemon:
         if view is None and self._restore_local(oid):
             view = self.store.get(oid)
         if view is None:
-            data = self.store.read_spilled(oid)
+            data = self.store.read_spilled_range(oid, p["offset"], p["length"])
             if data is not None:
-                return data[p["offset"] : p["offset"] + p["length"]]
+                return data
             raise KeyError(f"object {oid.hex()} not in store")
         try:
             return bytes(view[p["offset"] : p["offset"] + p["length"]])

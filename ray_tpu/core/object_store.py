@@ -193,6 +193,26 @@ class SharedMemoryClient:
         except FileNotFoundError:
             return None
 
+    def read_spilled_range(self, oid: ObjectID, offset: int, length: int) -> Optional[bytes]:
+        """Ranged disk read of a spilled payload (chunked remote pulls of a
+        spilled object must not re-read the whole file per chunk)."""
+        if not self.spill_dir:
+            return None
+        try:
+            with open(os.path.join(self.spill_dir, oid.hex()), "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except FileNotFoundError:
+            return None
+
+    def spilled_size(self, oid: ObjectID) -> Optional[int]:
+        if not self.spill_dir:
+            return None
+        try:
+            return os.path.getsize(os.path.join(self.spill_dir, oid.hex()))
+        except OSError:
+            return None
+
     def is_spilled(self, oid: ObjectID) -> bool:
         return bool(self.spill_dir) and os.path.exists(os.path.join(self.spill_dir, oid.hex()))
 

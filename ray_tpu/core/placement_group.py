@@ -24,20 +24,16 @@ class PlacementGroup:
         return self.bundles
 
     def ready(self, timeout: float | None = None) -> bool:
-        """Block until all bundles are reserved."""
+        """Block until all bundles are reserved (event-driven: the controller
+        parks the request until the PG flips CREATED/REMOVED; no polling)."""
         from ray_tpu.core import api
 
         core = api._require_worker()
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            info = core._run(core.controller.call("get_placement_group", {"pg_id": self.id}))
-            if info is not None and info["state"] == "CREATED":
-                return True
-            if info is None or info["state"] == "REMOVED":
-                return False
-            if deadline is not None and time.monotonic() >= deadline:
-                return False
-            time.sleep(0.05)
+        info = core._run(
+            core.controller.call("wait_placement_group", {"pg_id": self.id, "timeout": timeout}),
+            timeout=None if timeout is None else timeout + 10,
+        )
+        return info is not None and info.get("state") == "CREATED"
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
         return self.ready(timeout=timeout_seconds)

@@ -27,8 +27,10 @@ class RemoteFunction:
         from ray_tpu.core import api
 
         core = api._require_worker()
-        if self._fn_id is None:
+        # Re-export if the session changed (new controller = fresh KV).
+        if self._fn_id is None or getattr(self, "_fn_session", None) is not core:
             self._fn_id = core.export_callable("fn", self._fn)
+            self._fn_session = core
         refs = core.submit_task_sync(self._fn_id, args, kwargs, replace(self._opts))
         return refs[0] if self._opts.num_returns == 1 else refs
 

@@ -57,22 +57,33 @@ class _RefAwarePickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
-def serialize(value: Any) -> tuple[bytes, list]:
-    """Serialize ``value`` -> (payload bytes, contained ObjectRefs)."""
+def serialize_parts(value: Any) -> tuple[list, list, int]:
+    """Serialize ``value`` -> (payload parts, contained ObjectRefs, total
+    bytes). Parts are bytes/memoryviews in wire order; out-of-band pickle-5
+    buffers (ndarray payloads etc.) stay as zero-copy views so callers can
+    scatter-write them straight into shared memory without an intermediate
+    join (one memcpy for a large array put instead of two)."""
     buffers: list[pickle.PickleBuffer] = []
     f = io.BytesIO()
     p = _RefAwarePickler(f, buffer_callback=buffers.append)
     p.dump(value)
     body = f.getvalue()
     if buffers:
-        parts = [len(buffers).to_bytes(4, "little")]
+        parts: list = [b"B" + len(buffers).to_bytes(4, "little")]
         for b in buffers:
             raw = b.raw()
             parts.append(len(raw).to_bytes(8, "little"))
-            parts.append(bytes(raw))
+            parts.append(raw)
         parts.append(body)
-        return b"B" + b"".join(parts), p.contained_refs
-    return b"P" + body, p.contained_refs
+    else:
+        parts = [b"P", body]
+    return parts, p.contained_refs, sum(len(x) for x in parts)
+
+
+def serialize(value: Any) -> tuple[bytes, list]:
+    """Serialize ``value`` -> (payload bytes, contained ObjectRefs)."""
+    parts, refs, _total = serialize_parts(value)
+    return b"".join(parts), refs
 
 
 def deserialize(data: bytes | memoryview) -> Any:

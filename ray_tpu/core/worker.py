@@ -47,6 +47,9 @@ from ray_tpu.core.task_spec import ActorSpec, TaskOptions, TaskSpec, scheduling_
 logger = logging.getLogger(__name__)
 
 
+_MISS = object()  # sentinel: value not locally resident
+
+
 class ActorDiedError(Exception):
     pass
 
@@ -91,13 +94,35 @@ class _KeySubmitter:
         self.pending_lease_requests = 0
 
     def pump(self):
+        # Batch dispatch: when the queue is deeper than the worker pool, ship
+        # several specs per RPC (amortizes frame+serialization overhead; the
+        # worker still executes them serially, preserving one-task-at-a-time
+        # worker semantics). Shallow queues keep batch=1 for latency.
         while self.queue:
-            free = next((w for w in self.workers if not w.busy and not (w.conn and w.conn.closed)), None)
-            if free is None:
+            free_workers = [w for w in self.workers if not w.busy and not (w.conn and w.conn.closed)]
+            if not free_workers:
                 break
-            spec, fut = self.queue.pop(0)
-            free.busy = True
-            asyncio.create_task(self._dispatch(free, spec, fut))
+            per = max(1, min(16, (len(self.queue) + len(free_workers) - 1) // len(free_workers)))
+            for w in free_workers:
+                if not self.queue:
+                    break
+                # Non-retryable (max_retries=0) tasks ship alone: a worker
+                # crash mid-batch loses the whole reply, and tasks that DID
+                # execute must not be retro-failed/retried in bulk — singleton
+                # dispatch keeps their ambiguity window identical to unbatched.
+                items = []
+                while self.queue and len(items) < per:
+                    spec, fut = self.queue[0]
+                    retries = spec.options.max_retries
+                    if retries == -1:
+                        retries = self.core.config.max_task_retries_default
+                    if retries == 0 and items:
+                        break  # starts the next batch
+                    items.append(self.queue.pop(0))
+                    if retries == 0:
+                        break
+                w.busy = True
+                asyncio.create_task(self._dispatch(w, items))
         want = len(self.queue)
         while want > 0 and self.pending_lease_requests < min(want, self.core.config.max_pending_lease_requests_per_key):
             self.pending_lease_requests += 1
@@ -147,24 +172,26 @@ class _KeySubmitter:
             self.pending_lease_requests -= 1
             self.pump()
 
-    async def _dispatch(self, w: LeasedWorker, spec: TaskSpec, fut: asyncio.Future):
+    async def _dispatch(self, w: LeasedWorker, items: list[tuple[TaskSpec, asyncio.Future]]):
         try:
-            reply = await w.conn.call("push_task", {"spec": spec})
-            self.core._absorb_task_reply(spec, reply, fut)
+            reply = await w.conn.call("push_tasks", {"specs": [s for s, _ in items]})
+            for (spec, fut), r in zip(items, reply["results"]):
+                self.core._absorb_task_reply(spec, r, fut)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             await self._drop_worker(w, failed=True)
-            retries = spec.options.max_retries
-            if retries == -1:
-                retries = self.core.config.max_task_retries_default
-            attempts = getattr(spec, "_attempts", 0)
-            if attempts < retries:
-                spec._attempts = attempts + 1  # type: ignore[attr-defined]
-                logger.warning("task %s lost worker (%s); retry %d", spec.task_id.hex()[:8], e, attempts + 1)
-                self.queue.append((spec, fut))
-            else:
-                self.core._fail_task_returns(spec, RemoteError(f"task {spec.task_id.hex()[:8]} failed after retries: {e}"))
-                if not fut.done():
-                    fut.set_result(False)
+            for spec, fut in items:
+                retries = spec.options.max_retries
+                if retries == -1:
+                    retries = self.core.config.max_task_retries_default
+                attempts = getattr(spec, "_attempts", 0)
+                if attempts < retries:
+                    spec._attempts = attempts + 1  # type: ignore[attr-defined]
+                    logger.warning("task %s lost worker (%s); retry %d", spec.task_id.hex()[:8], e, attempts + 1)
+                    self.queue.append((spec, fut))
+                else:
+                    self.core._fail_task_returns(spec, RemoteError(f"task {spec.task_id.hex()[:8]} failed after retries: {e}"))
+                    if not fut.done():
+                        fut.set_result(False)
         finally:
             w.busy = False
             w.last_used = time.monotonic()
@@ -471,7 +498,48 @@ class CoreWorker:
 
     # -- put / get / wait ----------------------------------------------
     def put_sync(self, value: Any) -> ObjectRef:
-        return self._run(self.put_async(value))
+        """Owner-side put without blocking on the IO loop: serialization and
+        the store write happen on the caller's thread (both stores are
+        thread-safe); ownership registration is queued to the loop FIFO, so it
+        lands before any subsequent get/free touching the same object."""
+        oid = ObjectID.from_put()
+        parts, _refs, total = serialization.serialize_parts(value)
+        in_shm = self.store is not None and total > self.config.max_inline_object_size
+        evicted: list = []
+        if in_shm:
+            buf, evicted = self.store.create_autoevict(oid, total)
+            off = 0
+            for part in parts:  # scatter-write: no intermediate join copy
+                n = len(part)
+                buf[off : off + n] = part
+                off += n
+            del buf
+            self.store.seal(oid)
+        else:
+            self.memory_store.put(oid, b"".join(parts))
+
+        def _commit():
+            rec = self._register_owned(oid)
+            rec.local_refs += 1
+            self._mark_ready(oid, size=total, in_memory=not in_shm, in_shm=in_shm)
+            if in_shm:
+                asyncio.ensure_future(self._report_shm_put(oid, total, evicted))
+
+        self.loop.call_soon_threadsafe(_commit)
+        ref = ObjectRef(oid, self.address, total, _register=False)
+        ref._registered = True
+        return ref
+
+    async def _report_shm_put(self, oid: ObjectID, size: int, evicted: list):
+        if evicted:
+            await self._report_evicted(evicted)
+        try:
+            if self.daemon is not None:
+                await self.daemon.notify("report_sealed", {"oid": oid.binary(), "size": size})
+            else:
+                await self.controller.notify("report_object", {"oid": oid.binary(), "node_id": self.node_id, "size": size})
+        except Exception:
+            pass
 
     async def put_async(self, value: Any) -> ObjectRef:
         oid = ObjectID.from_put()
@@ -514,8 +582,38 @@ class CoreWorker:
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
-        out = self._run(self._get_many(list(refs)), timeout=timeout)
+        refs = list(refs)
+        # Fast path: values already resident on this host (owner memory store
+        # or the local shm arena) deserialize on the caller's thread with no
+        # IO-loop round trip — the common case for owner-side gets of finished
+        # results (reference: CoreWorkerMemoryStore GetIfExists fast path).
+        out: list = []
+        for r in refs:
+            v = self._try_local_value(r)
+            if v is _MISS:
+                break
+            out.append(v)
+        else:
+            return out[0] if single else out
+        out = self._run(self._get_many(refs), timeout=timeout)
         return out[0] if single else out
+
+    def _try_local_value(self, ref: ObjectRef):
+        """Return the deserialized value if locally resident, else _MISS.
+        Thread-safe: MemoryStore and SharedMemoryClient both lock internally;
+        `owned` is only read (GIL-atomic) to avoid error-state misreads."""
+        oid = ref.id
+        data = self.memory_store.get(oid)
+        if data is None:
+            rec = self.owned.get(oid)
+            if rec is not None and ref.owner_addr == self.address and rec.state != "READY":
+                return _MISS  # pending or failed: the slow path handles both
+            if self.store is None:
+                return _MISS
+            data = self._read_shm(oid)
+            if data is None:
+                return _MISS
+        return self._deserialize_value(data)
 
     async def get_async(self, ref: ObjectRef):
         return (await self._get_many([ref]))[0]
@@ -694,7 +792,9 @@ class CoreWorker:
                 try:
                     asyncio.get_running_loop().create_task(self._report_evicted(evicted))
                 except RuntimeError:
-                    pass
+                    # Caller-thread fast path: report via the IO loop.
+                    if self.loop is not None:
+                        asyncio.run_coroutine_threadsafe(self._report_evicted(evicted), self.loop)
             if restored:
                 view = self.store.get(oid)
             else:
@@ -758,41 +858,67 @@ class CoreWorker:
         return self._run(self.wait_async(refs, num_returns, timeout))
 
     async def wait_async(self, refs: list[ObjectRef], num_returns: int, timeout: float | None):
+        """Event-driven wait: owner-local refs block on their ready_event,
+        borrowed refs park one wait_owned RPC on the owner (which blocks
+        server-side on the same event) — no polling (the reference's Wait
+        similarly registers memory-store futures, core_worker.h:697)."""
         if num_returns > len(refs):
             raise ValueError("num_returns > len(refs)")
-        pending = {id(r): r for r in refs}
-        ready: list[ObjectRef] = []
+
         deadline = None if timeout is None else time.monotonic() + timeout
 
-        async def is_ready(r: ObjectRef) -> bool:
+        async def wait_one(i: int, r: ObjectRef) -> int:
             if self.memory_store.contains(r.id):
-                return True
+                return i
             rec = self.owned.get(r.id)
             if rec is not None and r.owner_addr == self.address:
-                return rec.state != "PENDING"
+                if rec.state == "PENDING":
+                    await rec.ready_event.wait()
+                return i
             if self.store is not None and self.store.contains_or_spilled(r.id):
-                return True
+                return i
             if r.owner_addr and r.owner_addr != self.address:
-                try:
-                    conn = await self._peer_conn(r.owner_addr)
-                    return bool(await conn.call("wait_owned", {"oid": r.id.binary(), "timeout": 0.001}))
-                except Exception:
-                    return False
-            return False
+                while True:
+                    # Bound each server-side park: an abandoned client task
+                    # (outer timeout) must not orphan an hour-long handler on
+                    # the owner — re-arm at most every 60s.
+                    remaining = 60.0 if deadline is None else max(0.05, min(60.0, deadline - time.monotonic()))
+                    try:
+                        conn = await self._peer_conn(r.owner_addr)
+                        if await conn.call("wait_owned", {"oid": r.id.binary(), "timeout": remaining}):
+                            return i
+                        # Owner says unavailable (freed/lost) or parked past
+                        # its window: back off; the outer deadline decides
+                        # when to give up.
+                        await asyncio.sleep(0.05)
+                    except Exception:
+                        await asyncio.sleep(self.config.rpc_retry_delay_s)
+            # Unknown provenance: resolve via a full get (rare).
+            await self._get_one(r)
+            return i
 
-        while True:
-            for key, r in list(pending.items()):
-                if await is_ready(r):
-                    ready.append(r)
-                    del pending[key]
-            if len(ready) >= num_returns or not pending:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            await asyncio.sleep(0.005)
-        order = {r.id: i for i, r in enumerate(refs)}
-        ready.sort(key=lambda r: order[r.id])
-        ready = ready[:num_returns]
+        tasks = [asyncio.ensure_future(wait_one(i, r)) for i, r in enumerate(refs)]
+        ready_idx: set[int] = set()
+        pending = set(tasks)
+        try:
+            while pending and len(ready_idx) < num_returns:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                done, pending = await asyncio.wait(pending, timeout=remaining, return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break  # timed out
+                for t in done:
+                    if t.exception() is None:
+                        ready_idx.add(t.result())
+        finally:
+            for t in pending:
+                t.cancel()
+            for t in tasks:
+                if not t.done():
+                    try:
+                        await t
+                    except (asyncio.CancelledError, Exception):
+                        pass
+        ready = [refs[i] for i in sorted(ready_idx)][:num_returns]
         ready_ids = {r.id for r in ready}
         not_ready = [r for r in refs if r.id not in ready_ids]
         return ready, not_ready
@@ -831,15 +957,20 @@ class CoreWorker:
             options=opts,
             caller_addr=self.address,
         )
-        # Ownership records must exist before the task can complete, else a
+        # One loop hop, no blocking: registration + submission run as a single
+        # FIFO callback, so they land before any subsequent get/free from this
+        # thread. Ownership records exist before the task can complete, else a
         # fast reply could free the returns before the refs pin them.
-        self._run(self._register_returns(return_refs))
+        def _go():
+            self._register_returns(return_refs)
+            asyncio.ensure_future(self._submit(spec, dep_refs))
+
+        self.loop.call_soon_threadsafe(_go)
         for r in return_refs:
             r._registered = True
-        self._run(self._submit(spec, dep_refs))
         return return_refs
 
-    async def _register_returns(self, refs):
+    def _register_returns(self, refs):
         for r in refs:
             rec = self._register_owned(r.id)
             rec.local_refs += 1
@@ -915,6 +1046,12 @@ class CoreWorker:
             fut.set_result(True)
 
     # -- task execution (executor side) --------------------------------
+    async def handle_push_tasks(self, conn, p):
+        """Execute a batch of pushed tasks sequentially (batched PushTask:
+        amortizes per-frame overhead when the submitter's queue is deep;
+        execution order and one-at-a-time semantics are unchanged)."""
+        return {"results": [await self.handle_push_task(conn, {"spec": s}) for s in p["specs"]]}
+
     async def handle_push_task(self, conn, p):
         """Execute a pushed task (reference: CoreWorkerService.PushTask ->
         TaskReceiver -> scheduling queue -> execute callback)."""
@@ -993,10 +1130,14 @@ class CoreWorker:
             method_name=method,
         )
         refs = [ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(num_returns)]
-        self._run(self._register_returns(refs))
+
+        def _go():
+            self._register_returns(refs)
+            asyncio.ensure_future(self._submit_actor_task(spec, dep_refs))
+
+        self.loop.call_soon_threadsafe(_go)
         for r in refs:
             r._registered = True
-        self._run(self._submit_actor_task(spec, dep_refs))
         return refs
 
     async def _submit_actor_task(self, spec: TaskSpec, dep_refs):
