@@ -5,7 +5,6 @@ bundle_scheduling_policy.h:73-97).
 """
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 from ray_tpu.core.ids import PlacementGroupID

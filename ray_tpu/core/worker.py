@@ -595,7 +595,9 @@ class CoreWorker:
             out.append(v)
         else:
             return out[0] if single else out
-        out = self._run(self._get_many(refs), timeout=timeout)
+        # Keep already-deserialized prefix values; only the remainder goes
+        # through the IO loop.
+        out = out + self._run(self._get_many(refs[len(out):]), timeout=timeout)
         return out[0] if single else out
 
     def _try_local_value(self, ref: ObjectRef):
@@ -918,6 +920,8 @@ class CoreWorker:
                         await t
                     except (asyncio.CancelledError, Exception):
                         pass
+                elif not t.cancelled():  # retrieve exceptions so GC doesn't log them
+                    t.exception()
         ready = [refs[i] for i in sorted(ready_idx)][:num_returns]
         ready_ids = {r.id for r in ready}
         not_ready = [r for r in refs if r.id not in ready_ids]

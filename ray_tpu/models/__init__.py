@@ -9,6 +9,7 @@ from ray_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
     cross_entropy_loss,
+    make_pipeline_train_step,
     make_train_step,
 )
 
@@ -16,5 +17,6 @@ __all__ = [
     "Transformer",
     "TransformerConfig",
     "cross_entropy_loss",
+    "make_pipeline_train_step",
     "make_train_step",
 ]
