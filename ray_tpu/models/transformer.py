@@ -173,22 +173,36 @@ def _rope(x, positions, theta):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
-def _attention(q, k, v, cfg: TransformerConfig, positions=None):
-    """Dispatch to the configured attention implementation."""
+def _attention(q, k, v, cfg: TransformerConfig, positions=None, segment_ids=None):
+    """Dispatch to the configured attention implementation.
+
+    q: [B,S,H,D]; k,v: [B,S,KV,D] — flash and reference handle grouped KV
+    natively (no repeat: the KV HBM-footprint saving is the point of GQA);
+    ring still expects full heads, so its K/V are expanded at the call site.
+    """
     impl = cfg.attention_impl
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "reference"
     if impl == "flash":
         from ray_tpu.ops.attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
     if impl == "ring":
         from ray_tpu.ops.ring_attention import ring_attention
 
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "ring attention does not support segment_ids yet; use "
+                "attention_impl='flash' (or 'reference') for packed sequences"
+            )
+        if k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         return ring_attention(q, k, v, axis_name="seq", causal=True)
     from ray_tpu.ops.attention import mha_reference
 
-    return mha_reference(q, k, v, causal=True)
+    return mha_reference(q, k, v, causal=True, segment_ids=segment_ids)
 
 
 def _dense_ffn(x, p):
@@ -240,7 +254,7 @@ def _load_balance_loss(weights, top_idx, n_experts):
     return n_experts * jnp.sum(me * ce)
 
 
-def _layer(x, lp, cfg: TransformerConfig, positions):
+def _layer(x, lp, cfg: TransformerConfig, positions, segment_ids=None):
     """One decoder block. x: [B, S, D] in cfg.dtype."""
     dt = x.dtype
     h = _rms_norm(x, lp["attn_norm"])
@@ -251,11 +265,8 @@ def _layer(x, lp, cfg: TransformerConfig, positions):
     k = wlc(k, ("batch", "seq", "kv_heads", "head_dim"))
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if cfg.kv_heads != cfg.n_heads:
-        rep = cfg.n_heads // cfg.kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    o = _attention(q, k, v, cfg, positions)
+    # Grouped K/V go to the kernel as-is (native GQA); see _attention.
+    o = _attention(q, k, v, cfg, positions, segment_ids)
     o = wlc(o, ("batch", "seq", "heads", "head_dim"))
     attn_out = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
     x = x + attn_out
@@ -269,14 +280,20 @@ def _layer(x, lp, cfg: TransformerConfig, positions):
     return x, aux
 
 
-def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+            segment_ids=None, positions=None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab].
+
+    Packed sequences: pass ``segment_ids`` [B, S] (attention masked within
+    segments) and per-segment-restarting ``positions`` [B, S] for RoPE.
+    """
     B, S = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = wlc(x, ("batch", "seq", "embed"))
-    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
-    body = functools.partial(_layer, cfg=cfg, positions=positions)
+    body = functools.partial(_layer, cfg=cfg, positions=positions, segment_ids=segment_ids)
     if cfg.remat:
         body = jax.checkpoint(body)
 
@@ -305,12 +322,25 @@ def _ce_from_logits(logits, targets, mask=None):
 
 
 def cross_entropy_loss(params, batch, cfg: TransformerConfig):
-    """batch: {"tokens": [B, S+1] int32} -> scalar mean NLL (+ MoE aux)."""
+    """batch: {"tokens": [B, S+1] int32, optional "mask"/"segment_ids"/
+    "positions"} -> scalar mean NLL (+ MoE aux). segment_ids enable packed-
+    sequence training (attention + loss respect example boundaries)."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, aux = forward(params, inputs, cfg)
-    mask = batch.get("mask")
-    loss = _ce_from_logits(logits, targets, None if mask is None else mask[:, 1:])
+    segs = batch.get("segment_ids")
+    pos = batch.get("positions")
+    logits, aux = forward(
+        params, inputs, cfg,
+        segment_ids=None if segs is None else segs[:, :-1],
+        positions=None if pos is None else pos[:, :-1],
+    )
+    mask = None if batch.get("mask") is None else batch["mask"][:, 1:].astype(jnp.float32)
+    if segs is not None:
+        # Don't train the position that predicts across a segment boundary;
+        # composes with any provided padding mask.
+        boundary = (segs[:, 1:] == segs[:, :-1]).astype(jnp.float32)
+        mask = boundary if mask is None else mask * boundary
+    loss = _ce_from_logits(logits, targets, mask)
     return loss + 0.01 * aux
 
 
@@ -393,6 +423,11 @@ def make_pipeline_train_step(cfg: TransformerConfig, mesh, n_micro: int, optimiz
     x_spec = P(None, data_axes if data_axes else None)
 
     def pipelined_loss(params, batch):
+        if batch.get("segment_ids") is not None or batch.get("positions") is not None:
+            raise NotImplementedError(
+                "packed sequences (segment_ids/positions) are not threaded "
+                "through the pipeline schedule yet; use make_train_step"
+            )
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         B, S = inputs.shape

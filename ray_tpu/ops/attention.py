@@ -5,15 +5,25 @@ VMEM with an online-softmax accumulator so the S×S score matrix never touches
 HBM (HBM traffic O(S·D) instead of O(S²)). Forward saves the per-row
 log-sum-exp so the backward pass recomputes probabilities blockwise.
 
-Layout: kernels operate on [BH, S, D] (batch*heads folded into the leading
-grid axis); blocks are (block_q × D) / (block_k × D) with D padded to a lane
-multiple of 128 by the caller's head_dim choice. Grid iteration order puts the
-K-block axis innermost ("arbitrary") so the f32 accumulators live in VMEM
-scratch across K steps (pallas_guide.md: Grid and Block Specifications).
+Native GQA: K/V carry their own (smaller) head count — the q-head grid maps
+onto kv heads through the BlockSpec index maps (q head h reads kv head
+h // group), so grouped K/V are NEVER materialized at full head count (the
+whole point of GQA is the smaller KV HBM footprint; a jnp.repeat would throw
+it away). The dk/dv backward iterates the q-heads of each group in its inner
+grid axis, accumulating into one kv-head scratch.
+
+Packed sequences: optional ``segment_ids`` [B, S] adds a block-wise
+same-segment mask (rows attend only within their segment), composed with the
+causal mask — the standard packed-example training contract.
+
+Layout: kernels operate on [B*H, S, D] for Q (and [B*KV, S, D] for K/V);
+blocks are (block_q × D)/(block_k × D) with D a lane multiple. Grid iteration
+puts the reduction axis innermost ("arbitrary") so f32 accumulators live in
+VMEM scratch across steps (pallas_guide.md: Grid and Block Specifications).
 
 The reference framework has no attention kernels (compute is delegated to
 torch/vLLM, SURVEY.md §2.4); functional parity target is the standard flash
-attention contract (causal MHA with LSE residuals).
+attention contract (causal MHA/GQA with LSE residuals + segment masking).
 """
 from __future__ import annotations
 
@@ -22,6 +32,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 DEFAULT_BLOCK_Q = 512
@@ -33,25 +44,52 @@ NEG_INF = -1e30
 # Reference implementation (numerical oracle + CPU fallback)
 # ---------------------------------------------------------------------------
 
-def mha_reference(q, k, v, causal=True, scale=None):
-    """q,k,v: [B, S, H, D] -> [B, S, H, D]. Softmax in f32."""
-    *_, D = q.shape
+def mha_reference(q, k, v, causal=True, scale=None, segment_ids=None):
+    """q: [B, S, H, D]; k,v: [B, S, KV, D] (KV divides H) -> [B, S, H, D].
+    Softmax in f32. segment_ids: optional [B, S] int; attention is masked to
+    same-segment pairs (packed sequences)."""
+    *_, H, D = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    S_q, S_k = s.shape[-2], s.shape[-1]
     if causal:
-        S_q, S_k = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((S_q, S_k), bool), k=S_k - S_q)
         s = jnp.where(mask, s, NEG_INF)
+    if segment_ids is not None:
+        seg = (segment_ids[:, None, :, None] == segment_ids[:, None, None, :])
+        s = jnp.where(seg, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _mask_scores(s, q_start, k_start, causal, seg_q, seg_k):
+    """Apply causal + segment masks to a [bq, bk] score block."""
+    if causal:
+        rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    if seg_q is not None:
+        s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
+    return s
 
 
 # ---------------------------------------------------------------------------
 # Pallas forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, block_q, block_k, n_k, causal):
+def _fwd_kernel(*refs, scale, block_q, block_k, n_k, causal, has_seg):
     from jax.experimental import pallas as pl
+
+    if has_seg:
+        q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        sq_ref = sk_ref = None
 
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -71,10 +109,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        if causal:
-            rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        seg_q = sq_ref[0, 0, :] if has_seg else None
+        seg_k = sk_ref[0, 0, :] if has_seg else None
+        s = _mask_scores(s, q_start, k_start, causal, seg_q, seg_k)
         m_prev = m_scr[:, 0]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         alpha = jnp.exp(m_prev - m_cur)
@@ -106,8 +143,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         lse_ref[0, :, :] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
-def _fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
-    """q,k,v: [BH, S, D] -> (o [BH, S, D], lse [BH, S] f32)."""
+def _fwd_pallas(q, k, v, seg, *, causal, scale, block_q, block_k, group, H, interpret):
+    """q: [BH, S, D]; k,v: [BKV, S, D]; seg: [B, 8, S] i32 or None
+    -> (o [BH, S, D], lse [BH, S] f32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -116,18 +154,28 @@ def _fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
     block_k = min(block_k, S)
     n_q = pl.cdiv(S, block_q)
     n_k = pl.cdiv(S, block_k)
+    has_seg = seg is not None
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, n_k=n_k, causal=causal
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, n_k=n_k,
+        causal=causal, has_seg=has_seg,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b // group, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b // group, ki, 0)),
+    ]
+    inputs = [q, k, v]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b // H, 0, qi)),
+            pl.BlockSpec((1, 8, block_k), lambda b, qi, ki: (b // H, 0, ki)),
+        ]
+        inputs += [seg, seg]
     return pl.pallas_call(
         kernel,
         grid=(BH, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
@@ -144,21 +192,32 @@ def _fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-    )(q, k, v)
+        interpret=interpret,
+    )(*inputs)
 
 
 # ---------------------------------------------------------------------------
 # Pallas backward (dk/dv kernel + dq kernel)
 # ---------------------------------------------------------------------------
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    dk_scr, dv_scr, *, scale, block_q, block_k, n_q, causal):
+def _bwd_dkv_kernel(*refs, scale, block_q, block_k, n_q, group, causal, has_seg):
+    """Grid: (B*KV, n_k, group*n_q) — the inner axis walks every (q-head of
+    the group) × (q-block), accumulating this kv head's dk/dv in scratch."""
     from jax.experimental import pallas as pl
 
-    ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        sq_ref = sk_ref = None
 
-    @pl.when(qi == 0)
+    ki = pl.program_id(1)
+    t = pl.program_id(2)
+    qi = t % n_q
+
+    @pl.when(t == 0)
     def _init():
         dk_scr[:, :] = jnp.zeros_like(dk_scr)
         dv_scr[:, :] = jnp.zeros_like(dv_scr)
@@ -176,10 +235,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        if causal:
-            rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        seg_q = sq_ref[0, 0, :] if has_seg else None
+        seg_k = sk_ref[0, 0, :] if has_seg else None
+        s = _mask_scores(s, q_start, k_start, causal, seg_q, seg_k)
         p = jnp.exp(s - lse[:, None])  # [bq, bk] f32
         # dv += p^T @ do
         dv_scr[:, :] += jax.lax.dot_general(
@@ -203,15 +261,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     else:
         _compute()
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(t == group * n_q - 1)
     def _finalize():
         dk_ref[0, :, :] = dk_scr[:, :].astype(dk_ref.dtype)
         dv_ref[0, :, :] = dv_scr[:, :].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, block_q, block_k, n_k, causal):
+def _bwd_dq_kernel(*refs, scale, block_q, block_k, n_k, causal, has_seg):
     from jax.experimental import pallas as pl
+
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+        sq_ref = sk_ref = None
 
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -233,10 +297,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
-            rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        seg_q = sq_ref[0, 0, :] if has_seg else None
+        seg_k = sk_ref[0, 0, :] if has_seg else None
+        s = _mask_scores(s, q_start, k_start, causal, seg_q, seg_k)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -259,41 +322,57 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, :, :] = dq_scr[:, :].astype(dq_ref.dtype)
 
 
-def _bwd_pallas(res, g, *, causal, scale, block_q, block_k):
+def _bwd_pallas(res, g, *, causal, scale, block_q, block_k, group, H, KV, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    q, k, v, o, lse = res
+    q, k, v, o, lse, seg = res
     do = g
     BH, S, D = q.shape
+    BKV = k.shape[0]
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     n_q = pl.cdiv(S, block_q)
     n_k = pl.cdiv(S, block_k)
+    has_seg = seg is not None
 
     delta_row = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta_row[:, None, :], (BH, 8, S))  # sublane-tiled like lse
 
+    # dk/dv: grid over kv heads; inner axis covers (group member g, q block).
+    # q-head for (kv-fold index b, inner step t): batch*H + kv*group + g.
+    def qhead(b, t):
+        return (b // KV) * H + (b % KV) * group + t // n_q
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, ki, t: (qhead(b, t), t % n_q, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, ki, t: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, ki, t: (b, ki, 0)),
+        pl.BlockSpec((1, block_q, D), lambda b, ki, t: (qhead(b, t), t % n_q, 0)),
+        pl.BlockSpec((1, 8, block_q), lambda b, ki, t: (qhead(b, t), 0, t % n_q)),
+        pl.BlockSpec((1, 8, block_q), lambda b, ki, t: (qhead(b, t), 0, t % n_q)),
+    ]
+    dkv_inputs = [q, k, v, do, lse, delta]
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, ki, t: (b // KV, 0, t % n_q)),
+            pl.BlockSpec((1, 8, block_k), lambda b, ki, t: (b // KV, 0, ki)),
+        ]
+        dkv_inputs += [seg, seg]
     dkv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k, n_q=n_q, causal=causal
+            _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            n_q=n_q, group=group, causal=causal, has_seg=has_seg,
         ),
-        grid=(BH, n_k, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda b, ki, qi: (b, 0, qi)),
-            pl.BlockSpec((1, 8, block_q), lambda b, ki, qi: (b, 0, qi)),
-        ],
+        grid=(BKV, n_k, group * n_q),
+        in_specs=dkv_in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, ki, t: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, ki, t: (b, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            jax.ShapeDtypeStruct((BKV, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BKV, S, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -302,29 +381,40 @@ def _bwd_pallas(res, g, *, causal, scale, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-    )(q, k, v, do, lse, delta)
+        interpret=interpret,
+    )(*dkv_inputs)
     dk, dv = dkv
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b // group, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b // group, ki, 0)),
+        pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
+        pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
+    ]
+    dq_inputs = [q, k, v, do, lse, delta]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b // H, 0, qi)),
+            pl.BlockSpec((1, 8, block_k), lambda b, qi, ki: (b // H, 0, ki)),
+        ]
+        dq_inputs += [seg, seg]
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k, n_k=n_k, causal=causal
+            _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            n_k=n_k, causal=causal, has_seg=has_seg,
         ),
         grid=(BH, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
-            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-    )(q, k, v, do, lse, delta)
+        interpret=interpret,
+    )(*dq_inputs)
     return dq, dk, dv
 
 
@@ -332,36 +422,57 @@ def _bwd_pallas(res, g, *, causal, scale, block_q, block_k):
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, causal, scale, block_q, block_k):
-    o, _ = _fwd_pallas(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _flash_folded(q, k, v, seg, causal, scale, block_q, block_k, group, H, KV, interpret):
+    o, _ = _fwd_pallas(
+        q, k, v, seg, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, group=group, H=H, interpret=interpret,
+    )
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    o, lse = _fwd_pallas(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, seg, causal, scale, block_q, block_k, group, H, KV, interpret):
+    o, lse = _fwd_pallas(
+        q, k, v, seg, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, group=group, H=H, interpret=interpret,
+    )
+    return o, (q, k, v, o, lse, seg)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, g):
-    return _bwd_pallas(res, g, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+def _flash_bwd(causal, scale, block_q, block_k, group, H, KV, interpret, res, g):
+    dq, dk, dv = _bwd_pallas(
+        res, g, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        group=group, H=H, KV=KV, interpret=interpret,
+    )
+    seg = res[5]
+    dseg = None if seg is None else np.zeros(seg.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
 
 
-_flash_bhsd.defvjp(_flash_fwd, _flash_bwd)
+_flash_folded.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=True, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Flash attention. q,k,v: [B, S, H, D] -> [B, S, H, D].
+def flash_attention(q, k, v, causal=True, scale=None, segment_ids=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=False):
+    """Flash attention. q: [B, S, H, D]; k,v: [B, S, KV, D] -> [B, S, H, D].
 
-    Uses the Pallas kernels on TPU; falls back to the jnp reference elsewhere
-    (CPU test meshes). S must be a multiple of 128 for the TPU path (callers
-    pad); D should be a lane multiple (64/128/256).
+    KV may be smaller than H (GQA): kv heads are shared across groups of
+    H // KV query heads inside the kernel — no repeat/materialization.
+    ``segment_ids`` [B, S] masks attention to same-segment pairs (packed
+    sequences). Uses the Pallas kernels on TPU (or anywhere with
+    interpret=True — the CPU test path); falls back to the jnp reference
+    otherwise. S must be a multiple of 128 for the TPU path (callers pad);
+    D should be a lane multiple (64/128/256).
     """
     B, S, H, D = q.shape
+    KV = k.shape[2]
+    if H % KV:
+        raise ValueError(f"n_heads {H} not divisible by kv_heads {KV}")
+    group = H // KV
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    if jax.default_backend() != "tpu" or S % 128 != 0:
-        return mha_reference(q, k, v, causal=causal, scale=scale)
+    if (jax.default_backend() != "tpu" and not interpret) or S % 128 != 0:
+        return mha_reference(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
     # Blocks must divide S exactly: Pallas pads out-of-bounds block reads with
     # undefined data, and the non-causal path applies no mask that would
     # neutralize padded key columns. S is a multiple of 128 here, so halving
@@ -370,8 +481,14 @@ def flash_attention(q, k, v, causal=True, scale=None,
         block_q //= 2
     while S % block_k:
         block_k //= 2
-    # [B,S,H,D] -> [B*H, S, D]
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    unfold = lambda x: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
-    o = _flash_bhsd(fold(q), fold(k), fold(v), causal, scale, block_q, block_k)
-    return unfold(o)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(-1, S, D)  # [B,S,h,D] -> [B*h,S,D]
+    seg = None
+    if segment_ids is not None:
+        seg = jnp.broadcast_to(
+            segment_ids.astype(jnp.int32)[:, None, :], (B, 8, S)
+        )  # sublane-tiled like lse
+    o = _flash_folded(
+        fold(q), fold(k), fold(v), seg, causal, scale, block_q, block_k,
+        group, H, KV, interpret,
+    )
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
