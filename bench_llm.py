@@ -1,0 +1,90 @@
+"""LLM serving benchmark: continuous-batching engine TTFT + decode throughput
+on the attached TPU (BASELINE.md target row: "Serve Llama-8B-class on v5e,
+continuous batching, p50 TTFT tracked" — model scaled to the single bench
+chip, same engine code path).
+
+Prints one JSON line; writes BENCH_LLM.json.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from ray_tpu.llm import EngineConfig, LLMEngine
+    from ray_tpu.models import TransformerConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32_000, d_model=1024, n_layers=12, n_heads=16,
+            n_kv_heads=4, d_ff=4096, max_seq_len=2048, attention_impl="auto",
+        )
+        n_requests, prompt_len, max_tokens, slots = 32, 512, 64, 8
+    else:  # CPU smoke
+        cfg = TransformerConfig(
+            vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=256, attention_impl="reference",
+        )
+        n_requests, prompt_len, max_tokens, slots = 4, 32, 8, 2
+
+    engine = LLMEngine(
+        cfg,
+        engine_config=EngineConfig(
+            max_slots=slots, max_seq=cfg.max_seq_len,
+            prefill_buckets=(128, 256, 512, 1024),
+        ),
+    )
+    rng = np.random.default_rng(0)
+
+    # Warm both programs (compile outside the measured window).
+    engine.generate(rng.integers(0, cfg.vocab_size, prompt_len), max_tokens=2)
+    # Unloaded TTFT: one isolated request on an idle engine.
+    unloaded = engine.generate(rng.integers(0, cfg.vocab_size, prompt_len), max_tokens=2)["ttft_s"]
+
+    ttfts = []
+    decoded = 0
+    t_start = time.perf_counter()
+    for i in range(n_requests):
+        engine.add_request(f"q{i}", rng.integers(0, cfg.vocab_size, prompt_len), max_tokens)
+    while engine.has_work():
+        for rid, ev in engine.step().items():
+            if ev.get("ttft_s") is not None and not ev.get("finished"):
+                ttfts.append(ev["ttft_s"])
+            if ev.get("finished"):
+                if ev.get("ttft_s") is not None and len(ttfts) < n_requests:
+                    ttfts.append(ev["ttft_s"])
+                decoded += len(ev["tokens"])
+    elapsed = time.perf_counter() - t_start
+
+    ttfts = np.array(sorted(ttfts))
+    result = {
+        "metric": "serve_ttft_p50",
+        "value": round(float(np.percentile(ttfts, 50)), 4),
+        "unit": "s",
+        "vs_baseline": None,  # reference publishes no TPU serving numbers (BASELINE.md)
+        "detail": {
+            "ttft_unloaded_s": round(float(unloaded), 4),
+            "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 4),
+            "decode_tokens_per_sec": round(decoded / elapsed, 1),
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "max_tokens": max_tokens,
+            "slots": slots,
+            "total_wall_s": round(elapsed, 3),
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+        },
+    }
+    print(json.dumps(result))
+    with open("BENCH_LLM.json", "w") as f:
+        json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""ray_tpu.llm: TPU-native LLM serving.
+
+Role-equivalent to the reference's LLM stack (python/ray/llm — LLMServer
+llm/_internal/serve/core/server/llm_server.py:99 + VLLMEngine
+engines/vllm/vllm_engine.py:174, where continuous batching lives inside
+vLLM). Here the engine is JAX-native: a slot-based KV cache with static
+shapes, a jitted prefill per length bucket, and one jitted decode step over
+all slots — continuous batching is the host loop admitting/retiring slots
+between steps.
+"""
+from ray_tpu.llm.engine import EngineConfig, LLMEngine
+from ray_tpu.llm.deployment import LLMServer, build_llm_app
+
+__all__ = ["EngineConfig", "LLMEngine", "LLMServer", "build_llm_app"]

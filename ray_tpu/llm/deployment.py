@@ -1,0 +1,105 @@
+"""LLM serving deployment: continuous-batching replica for ray_tpu.serve.
+
+Role-equivalent to the reference's LLMServer deployment
+(llm/_internal/serve/core/server/llm_server.py:99): a serve replica hosting
+one engine; concurrent generate() calls from the router land in the engine's
+waiting queue and are batched at iteration level by a background loop thread,
+so max_ongoing_requests concurrency maps directly onto engine slots.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class LLMServer:
+    """Serve-deployable callable: hosts an LLMEngine + stepping thread.
+
+    Use through build_llm_app() or directly:
+        app = serve.deployment(LLMServer).options(...).bind(cfg_kwargs, engine_kwargs)
+    """
+
+    def __init__(self, model_config: dict, engine_config: Optional[dict] = None):
+        import jax
+
+        from ray_tpu.llm.engine import EngineConfig, LLMEngine
+        from ray_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(**model_config)
+        ec = EngineConfig(**(engine_config or {}))
+        self.engine = LLMEngine(cfg, engine_config=ec)
+        self._cond = threading.Condition()
+        self._done: dict[str, dict] = {}
+        self._ttft: dict[str, float] = {}
+        self._counter = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, name="llm-engine", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            with self._cond:
+                if not self.engine.has_work():
+                    self._cond.wait(timeout=0.05)
+                    continue
+            events = self.engine.step()
+            if not events:
+                continue
+            with self._cond:
+                for rid, ev in events.items():
+                    if ev.get("ttft_s") is not None:
+                        self._ttft[rid] = ev["ttft_s"]
+                    if ev.get("finished"):
+                        self._done[rid] = {
+                            "tokens": ev["tokens"],
+                            "ttft_s": self._ttft.pop(rid, ev.get("ttft_s")),
+                        }
+                self._cond.notify_all()
+
+    def generate(self, tokens, max_tokens: int = 64, timeout_s: float = 300.0) -> dict:
+        """Blocking generate; safe to call from many router threads at once —
+        the engine batches all in-flight requests per decode iteration."""
+        with self._cond:
+            self._counter += 1
+            rid = f"r{self._counter}-{time.monotonic_ns()}"
+            self.engine.add_request(rid, tokens, max_tokens)
+            self._cond.notify_all()
+            deadline = time.time() + timeout_s
+            while rid not in self._done:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"generate timed out after {timeout_s}s")
+                self._cond.wait(timeout=min(remaining, 1.0))
+            return self._done.pop(rid)
+
+    def __call__(self, request: dict) -> dict:
+        return self.generate(
+            request["tokens"], int(request.get("max_tokens", 64))
+        )
+
+    def check_health(self) -> bool:
+        return self._thread.is_alive()
+
+    def stats(self) -> dict:
+        active = sum(1 for s in self.engine.slots if s is not None)
+        return {"active_slots": active, "waiting": len(self.engine.waiting)}
+
+    def __raytpu_exit__(self):
+        self._stop = True
+
+
+def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
+                  num_replicas: int = 1, max_ongoing_requests: Optional[int] = None):
+    """Build a serve application serving this model. max_ongoing_requests
+    defaults to the engine's slot count (router admission == engine capacity)."""
+    from ray_tpu import serve
+    from ray_tpu.llm.engine import EngineConfig
+
+    slots = EngineConfig(**(engine_config or {})).max_slots
+    dep = serve.deployment(LLMServer).options(
+        name="llm",
+        num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests or slots,
+    )
+    return dep.bind(model_config, engine_config)

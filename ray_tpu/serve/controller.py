@@ -55,6 +55,8 @@ class _DeploymentState:
         self.app = app_name
         self.spec = spec  # {name, blob, config-dict, route_prefix}
         self.replicas: dict[str, Any] = {}  # name -> ActorHandle
+        self.replica_rev: dict[str, int] = {}  # name -> spec_rev it was built from
+        self.spec_rev = 0  # bumped on every code/config change (rolling update)
         self.version = 0
         self.target = spec["config"]["initial_replicas"]
         self.demand: dict[int, tuple[float, float]] = {}  # handle_id -> (demand, ts)
@@ -94,9 +96,12 @@ class ServeController:
                 else:
                     st = _DeploymentState(app_name, spec)
                     if prev is not None:
-                        # Code/config changed: keep old replicas for teardown,
-                        # bump version so routers re-resolve.
+                        # Code/config changed: adopt the old replicas at their
+                        # old spec_rev; _reconcile rolls them over (new-code
+                        # replicas start first, stale ones then stop).
                         st.replicas = prev.replicas
+                        st.replica_rev = prev.replica_rev
+                        st.spec_rev = prev.spec_rev + 1
                         st.version = prev.version + 1
                         if prev.spec["config"] == spec["config"]:
                             st.target = prev.target
@@ -203,28 +208,49 @@ class ServeController:
             self._stop.wait(0.1)
 
     def _reconcile(self, dep: _DeploymentState) -> bool:
-        """Drive actual replica count to dep.target."""
+        """Drive actual replica count to dep.target, rolling stale-code
+        replicas over to the current spec (new replicas first, then stale
+        ones stop — reference: deployment_state.py rolling updates)."""
         changed = False
         with self.lock:
             want = dep.target
-            have = len(dep.replicas)
-        while have < want:
-            if self._start_replica(dep):
-                changed = True
-                have += 1
+            fresh = [n for n in dep.replicas if dep.replica_rev.get(n, -1) == dep.spec_rev]
+            stale = [n for n in dep.replicas if dep.replica_rev.get(n, -1) != dep.spec_rev]
+        started_any = False
+        while len(fresh) < want:
+            name = self._start_replica(dep)
+            if name:
+                changed = started_any = True
+                fresh.append(name)
             else:
                 break  # no capacity now; retry next tick
-        if have > want:
-            with self.lock:
-                excess = list(dep.replicas)[want - have :]
-            for name in excess:
+        if len(fresh) >= want and stale:
+            # Enough current-code capacity: retire old code.
+            for name in stale:
+                self._stop_replica(dep, name)
+            changed = True
+        elif stale and not started_any and len(fresh) + len(stale) >= want:
+            # Capacity-saturated roll (stale replicas hold the resources the
+            # new ones need): stop ONE stale replica so the next tick can
+            # place its replacement — converges one-by-one instead of
+            # wedging in UPDATING forever. The >= want guard caps the drain
+            # at a single in-flight hole, so a new version that fails to
+            # start cannot progressively take down working old replicas.
+            self._stop_replica(dep, stale[0])
+            changed = True
+        if len(fresh) > want:
+            for name in fresh[want:]:
                 self._stop_replica(dep, name)
             changed = True
         with self.lock:
-            dep.status = "HEALTHY" if len(dep.replicas) >= dep.target else "UPDATING"
+            n_fresh = sum(1 for n in dep.replicas if dep.replica_rev.get(n, -1) == dep.spec_rev)
+            dep.status = "HEALTHY" if n_fresh >= dep.target and not any(
+                dep.replica_rev.get(n, -1) != dep.spec_rev for n in dep.replicas
+            ) else "UPDATING"
         return changed
 
-    def _start_replica(self, dep: _DeploymentState) -> bool:
+    def _start_replica(self, dep: _DeploymentState) -> Optional[str]:
+        """Start one replica from the CURRENT spec; returns its name."""
         import ray_tpu as rt
         from ray_tpu.serve.replica import Replica
 
@@ -251,17 +277,19 @@ class ServeController:
             rt.get(handle.check_health.remote(), timeout=60)
         except Exception:
             traceback.print_exc()
-            return False
+            return None
         with self.lock:
             dep.replicas[actor_name] = handle
+            dep.replica_rev[actor_name] = dep.spec_rev
             dep.version += 1
-        return True
+        return actor_name
 
     def _stop_replica(self, dep: _DeploymentState, name: str):
         import ray_tpu as rt
 
         with self.lock:
             handle = dep.replicas.pop(name, None)
+            dep.replica_rev.pop(name, None)
             dep.version += 1
         if handle is None:
             return
@@ -296,6 +324,7 @@ class ServeController:
         for name in dead:
             with self.lock:
                 dep.replicas.pop(name, None)
+                dep.replica_rev.pop(name, None)
                 dep.version += 1
             # Best-effort kill in case it's alive-but-unhealthy.
             try:
@@ -347,7 +376,14 @@ class ServeController:
                 "routes": dict(self.routes),
                 "apps": {
                     a: [
-                        {"spec": d.spec, "replica_names": list(d.replicas), "version": d.version, "target": d.target}
+                        {
+                            "spec": d.spec,
+                            "replica_names": list(d.replicas),
+                            "replica_rev": dict(d.replica_rev),
+                            "spec_rev": d.spec_rev,
+                            "version": d.version,
+                            "target": d.target,
+                        }
                         for d in deps.values()
                     ]
                     for a, deps in self.apps.items()
@@ -377,10 +413,12 @@ class ServeController:
                 st = _DeploymentState(app_name, rec["spec"])
                 st.version = rec["version"] + 1  # force router re-resolve
                 st.target = rec["target"]
+                st.spec_rev = rec.get("spec_rev", 0)
                 # Re-adopt surviving detached replicas by name.
                 for name in rec["replica_names"]:
                     try:
                         st.replicas[name] = rt.get_actor(name, namespace=SERVE_NAMESPACE)
+                        st.replica_rev[name] = rec.get("replica_rev", {}).get(name, st.spec_rev)
                     except ValueError:
                         pass
                 table[rec["spec"]["name"]] = st

@@ -57,58 +57,80 @@ class _ReplicaSet:
         self.app = app_name
         self.deployment = deployment_name
         self.cond = threading.Condition()
-        self.replicas: list[Any] = []  # ActorHandles
+        self.replicas: dict[str, Any] = {}  # replica name -> ActorHandle
         self.max_ongoing = 8
-        self.ongoing: dict[int, int] = {}  # index -> in-flight count
+        # In-flight counts keyed by replica NAME: they survive membership
+        # refreshes (an index-keyed reset would both lift admission limits on
+        # busy replicas and credit completions to the wrong replica).
+        self.ongoing: dict[str, int] = {}
         self.version = -1
         self.fetched_at = 0.0
         self.queued = 0
         self._closed = False
-        self._outstanding: list[tuple[Any, int]] = []  # (ref, replica_idx)
+        self._refreshing = False
+        self._outstanding: list[tuple[Any, str]] = []  # (ref, replica_name)
         self._drainer: Optional[threading.Thread] = None
         self._pusher: Optional[threading.Thread] = None
 
     # -- membership --------------------------------------------------------
-    def _refresh_locked(self, force: bool = False):
-        now = time.time()
-        if not force and now - self.fetched_at < self.REFRESH_S and self.replicas:
-            return
+    def _maybe_refresh(self):
+        """Fetch routing info WITHOUT holding the lock (a slow controller must
+        not stall routing/draining); apply the result under the lock."""
+        with self.cond:
+            now = time.time()
+            if self._refreshing or (now - self.fetched_at < self.REFRESH_S and self.replicas):
+                return
+            self._refreshing = True
         import ray_tpu as rt
 
-        info = rt.get(
-            _controller().get_routing_info.remote(self.app, self.deployment),
-            timeout=30,
-        )
-        self.fetched_at = time.time()
-        if info is None:
-            self.replicas, self.version = [], -1
-            return
-        if info["version"] != self.version:
-            handles = []
-            for name in info["replica_names"]:
-                try:
-                    handles.append(rt.get_actor(name, namespace=SERVE_NAMESPACE))
-                except ValueError:
-                    continue  # replica died between snapshot and lookup
-            self.replicas = handles
-            self.version = info["version"]
-            self.max_ongoing = info["max_ongoing_requests"]
-            self.ongoing = {i: 0 for i in range(len(handles))}
-            self.cond.notify_all()
+        try:
+            info = rt.get(
+                _controller().get_routing_info.remote(self.app, self.deployment),
+                timeout=30,
+            )
+            handles = {}
+            if info is not None:
+                for name in info["replica_names"]:
+                    try:
+                        handles[name] = rt.get_actor(name, namespace=SERVE_NAMESPACE)
+                    except ValueError:
+                        continue  # replica died between snapshot and lookup
+        except Exception:
+            with self.cond:
+                self._refreshing = False
+                self.fetched_at = time.time()  # back off before retrying
+            raise
+        with self.cond:
+            self._refreshing = False
+            self.fetched_at = time.time()
+            if info is None:
+                self.replicas, self.version = {}, -1
+                return
+            if info["version"] != self.version:
+                self.replicas = handles
+                self.version = info["version"]
+                self.max_ongoing = info["max_ongoing_requests"]
+                # Keep counts for surviving replicas; drop departed ones.
+                self.ongoing = {n: self.ongoing.get(n, 0) for n in handles}
+                self.cond.notify_all()
 
     # -- routing -----------------------------------------------------------
     def route(self, method: str, args: tuple, kwargs: dict, timeout_s: float = 60.0):
-        """Pick a replica (pow-2 choices), submit, return (ref, idx)."""
+        """Pick a replica (pow-2 choices), submit, return (ref, name)."""
         deadline = time.time() + timeout_s
         with self.cond:
             self.queued += 1
-            try:
-                while True:
-                    self._refresh_locked()
-                    idx = self._pick_locked()
-                    if idx is not None:
-                        self.ongoing[idx] += 1
-                        replica = self.replicas[idx]
+        try:
+            while True:
+                try:
+                    self._maybe_refresh()
+                except Exception:
+                    pass  # transient controller hiccup: retry until deadline
+                with self.cond:
+                    name = self._pick_locked()
+                    if name is not None:
+                        self.ongoing[name] = self.ongoing.get(name, 0) + 1
+                        replica = self.replicas[name]
                         break
                     remaining = deadline - time.time()
                     if remaining <= 0:
@@ -119,31 +141,32 @@ class _ReplicaSet:
                     # Re-poll membership at least every REFRESH_S while queued.
                     self.cond.wait(timeout=min(remaining, self.REFRESH_S))
                     self.fetched_at = 0.0  # force refresh after a wait
-            finally:
+        finally:
+            with self.cond:
                 self.queued -= 1
         try:
             ref = replica.handle_request.remote(method, args, kwargs)
         except Exception:
             with self.cond:
-                self.ongoing[idx] -= 1
+                self.ongoing[name] = max(0, self.ongoing.get(name, 1) - 1)
                 self.fetched_at = 0.0
                 self.cond.notify_all()
             raise
         with self.cond:
-            self._outstanding.append((ref, idx))
+            self._outstanding.append((ref, name))
             self._ensure_threads()
-        return ref, idx
+        return ref, name
 
-    def _pick_locked(self) -> Optional[int]:
-        live = [i for i in range(len(self.replicas)) if self.ongoing.get(i, 0) < self.max_ongoing]
+    def _pick_locked(self) -> Optional[str]:
+        live = [n for n in self.replicas if self.ongoing.get(n, 0) < self.max_ongoing]
         if not live:
             return None
         if len(live) == 1:
             return live[0]
         a, b = random.sample(live, 2)
-        return a if self.ongoing[a] <= self.ongoing[b] else b
+        return a if self.ongoing.get(a, 0) <= self.ongoing.get(b, 0) else b
 
-    def fail_over(self, idx: int):
+    def fail_over(self, name: str):
         """A request observed this replica dead: force membership refresh."""
         with self.cond:
             self.version = -1
@@ -186,12 +209,12 @@ class _ReplicaSet:
             done = set(id(r) for r in ready)
             with self.cond:
                 kept = []
-                for ref, idx in self._outstanding:
+                for ref, name in self._outstanding:
                     if id(ref) in done:
-                        if idx in self.ongoing:
-                            self.ongoing[idx] = max(0, self.ongoing[idx] - 1)
+                        if name in self.ongoing:
+                            self.ongoing[name] = max(0, self.ongoing[name] - 1)
                     else:
-                        kept.append((ref, idx))
+                        kept.append((ref, name))
                 self._outstanding = kept
                 self.cond.notify_all()
 

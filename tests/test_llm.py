@@ -1,0 +1,126 @@
+"""LLM engine + serving: KV-cache decode correctness vs full forward,
+continuous batching consistency, TTFT reporting, serve integration.
+Reference analogue: python/ray/llm/tests (MockVLLMEngine-based serving tests,
+SURVEY §4) — here the engine is real, just tiny and on CPU."""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.llm import EngineConfig, LLMEngine
+from ray_tpu.models import TransformerConfig
+from ray_tpu.models.transformer import forward, init_params
+
+CFG = TransformerConfig(
+    vocab_size=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+    max_seq_len=128, dtype=jnp.float32, attention_impl="reference",
+)
+
+
+def _naive_greedy(params, prompt, n):
+    toks = list(map(int, prompt))
+    out = []
+    for _ in range(n):
+        logits, _ = forward(params, jnp.asarray([toks], jnp.int32), CFG)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        toks.append(nxt)
+        out.append(nxt)
+    return out
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return LLMEngine(CFG, engine_config=EngineConfig(max_slots=4, max_seq=128, prefill_buckets=(16, 32, 64)))
+
+
+def test_cached_decode_matches_full_forward(engine):
+    prompt = np.array([5, 17, 42, 7, 23], np.int32)
+    want = _naive_greedy(engine.params, prompt, 12)
+    got = engine.generate(prompt, max_tokens=12)
+    assert got["tokens"] == want
+    assert got["ttft_s"] is not None and got["ttft_s"] > 0
+
+
+def test_continuous_batching_matches_solo(engine):
+    """A request joining mid-decode must not perturb an in-flight one, and
+    both must equal their solo outputs (slot isolation)."""
+    p1 = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+    p2 = np.array([2, 7, 1, 8], np.int32)
+    solo1 = engine.generate(p1, max_tokens=10)["tokens"]
+    solo2 = engine.generate(p2, max_tokens=10)["tokens"]
+
+    engine.add_request("a", p1, 10)
+    results = {}
+    for _ in range(3):  # a starts decoding alone
+        for rid, ev in engine.step().items():
+            if ev.get("finished"):
+                results[rid] = ev["tokens"]
+    engine.add_request("b", p2, 10)  # b joins mid-flight
+    while engine.has_work():
+        for rid, ev in engine.step().items():
+            if ev.get("finished"):
+                results[rid] = ev["tokens"]
+    assert results["a"] == solo1
+    assert results["b"] == solo2
+
+
+def test_slot_reuse_after_finish(engine):
+    """More requests than slots: queueing + slot recycling must preserve
+    per-request outputs."""
+    prompts = [np.arange(3 + i, dtype=np.int32) % 97 for i in range(9)]
+    solos = [engine.generate(p, max_tokens=6)["tokens"] for p in prompts]
+    for i, p in enumerate(prompts):
+        engine.add_request(f"r{i}", p, 6)
+    results = {}
+    while engine.has_work():
+        for rid, ev in engine.step().items():
+            if ev.get("finished"):
+                results[rid] = ev["tokens"]
+    for i in range(9):
+        assert results[f"r{i}"] == solos[i], i
+
+
+def test_eos_stops_generation():
+    eng = LLMEngine(
+        CFG,
+        engine_config=EngineConfig(max_slots=2, max_seq=128, prefill_buckets=(16,), eos_id=0),
+    )
+    out = eng.generate(np.array([5, 6, 7], np.int32), max_tokens=40)
+    if 0 in out["tokens"]:
+        assert out["tokens"].index(0) == len(out["tokens"]) - 1
+
+
+def test_llm_serve_deployment():
+    import ray_tpu as rt
+    from ray_tpu import serve
+    from ray_tpu.llm import build_llm_app
+
+    rt.init(num_cpus=8)
+    serve.start(proxy=False)
+    try:
+        app = build_llm_app(
+            model_config=dict(
+                vocab_size=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                d_ff=128, max_seq_len=128, attention_impl="reference",
+            ),
+            engine_config={"max_slots": 4, "max_seq": 128, "prefill_buckets": (16, 32)},
+        )
+        handle = serve.run(app, name="llm_app", http=False)
+        # Concurrent requests batch at iteration level on one replica.
+        resps = [
+            handle.remote({"tokens": [3, 1, 4, 1, 5], "max_tokens": 8})
+            for _ in range(4)
+        ]
+        outs = [r.result(timeout=120) for r in resps]
+        first = outs[0]["tokens"]
+        assert len(first) == 8
+        for o in outs:
+            assert o["tokens"] == first  # same prompt, greedy -> same output
+            assert o["ttft_s"] is not None
+        serve.delete("llm_app")
+    finally:
+        serve.shutdown()
+        rt.shutdown()

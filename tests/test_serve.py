@@ -303,3 +303,29 @@ def test_controller_crash_recovery(serve_cluster):
     # Data path still works on the recovered control plane.
     assert handle.remote(5).result(timeout=30) == 6
     serve.delete("ctl_ft")
+
+
+def test_redeploy_rolls_replicas_to_new_code(serve_cluster):
+    """Redeploying changed code must retire old-code replicas (rolling update;
+    reference: deployment_state.py)."""
+    @serve.deployment
+    def versioned(x):
+        return {"version": 1, "x": x}
+
+    h = serve.run(versioned.bind(), name="roll_app", http=False)
+    assert h.remote(0).result()["version"] == 1
+
+    @serve.deployment(name="versioned")
+    def versioned2(x):
+        return {"version": 2, "x": x}
+
+    h2 = serve.run(versioned2.bind(), name="roll_app", http=False)
+    deadline = time.time() + 30
+    seen = None
+    while time.time() < deadline:
+        seen = h2.remote(0).result()["version"]
+        if seen == 2:
+            break
+        time.sleep(0.25)
+    assert seen == 2, f"still serving old code: {seen}"
+    serve.delete("roll_app")
