@@ -63,10 +63,11 @@ class _ServiceHost:
 class Cluster:
     """Multi-node cluster on one machine (reference: cluster_utils.Cluster)."""
 
-    def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None, config: Config | None = None):
+    def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None,
+                 config: Config | None = None, persist_path: str | None = None):
         self.config = config or get_config()
         self.host = _ServiceHost()
-        self.controller = Controller(self.config)
+        self.controller = Controller(self.config, persist_path=persist_path)
         self.controller_addr = self.host.call(self.controller.start())
         self.daemons: list[NodeDaemon] = []
         if initialize_head:
@@ -104,6 +105,17 @@ class Cluster:
         self.host.call(daemon.start())
         self.daemons.append(daemon)
         return daemon
+
+    def restart_controller(self):
+        """Stop the controller abruptly and start a fresh one on the same
+        address (control-plane FT: the replacement restores from the snapshot
+        and daemons/drivers re-register over their persistent connections —
+        reference: GCS restart with Redis persistence, gcs_server.h:136)."""
+        port = int(self.controller_addr.rsplit(":", 1)[1])
+        persist = self.controller.persist_path
+        self.host.call(self.controller.stop())
+        self.controller = Controller(self.config, persist_path=persist)
+        self.host.call(self.controller.start(port))
 
     def remove_node(self, daemon: NodeDaemon):
         if daemon in self.daemons:

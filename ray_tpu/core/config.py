@@ -61,6 +61,9 @@ class Config:
     task_retry_delay_s: float = 0.05
     max_task_retries_default: int = 3
     lineage_max_bytes: int = 64 * 1024 * 1024
+    # Grace period after a controller restart for daemons to re-confirm
+    # restored-ALIVE actors before the restart FSM declares their workers lost.
+    controller_reconcile_grace_s: float = 10.0
     # --- logging/metrics ---
     log_dir: str = ""
     metrics_report_interval_s: float = 5.0

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -128,8 +129,15 @@ def _labels_match(labels: dict, selector: dict) -> bool:
 
 
 class Controller:
-    def __init__(self, config: Config, host: str = "127.0.0.1"):
+    def __init__(self, config: Config, host: str = "127.0.0.1", persist_path: str | None = None):
+        """persist_path enables control-plane fault tolerance: hard state
+        (KV, actors, PGs, jobs, named-actor table) snapshots to this file and
+        a restarted Controller on the same address restores it, re-adopting
+        daemons/actors as they re-register (reference: GCS FT via a
+        persistent StoreClient, gcs_server.h:136 kRedisStorage; here a local
+        snapshot file plays the Redis role — same recovery contract)."""
         self.config = config
+        self.persist_path = persist_path
         self.server = rpc.RpcServer(self, host=host)
         self.nodes: dict[str, NodeRecord] = {}
         self.kv: dict[str, dict[str, bytes]] = {}  # namespace -> {key: value}
@@ -147,23 +155,151 @@ class Controller:
         self._rr_counter = 0
         self._bg: list[asyncio.Task] = []
         self.events: list[dict] = []  # structured event log (ray_event_recorder equiv)
+        self._dirty = False
+        # Actors restored from a snapshot as ALIVE/RESTARTING must be
+        # re-confirmed by their daemon's re-registration within the grace
+        # window, else their worker is assumed gone and the restart FSM runs.
+        self._unconfirmed_actors: set[ActorID] = set()
+        self._reconcile_deadline: float | None = None
+        if persist_path:
+            self._restore_snapshot()
 
     # ------------------------------------------------------------------
     async def start(self, port: int = 0) -> str:
         addr = await self.server.start(port)
         self._bg.append(asyncio.create_task(self._health_check_loop()))
+        if self.persist_path:
+            self._bg.append(asyncio.create_task(self._snapshot_loop()))
         logger.info("controller listening on %s", addr)
         return addr
 
     async def stop(self):
         for t in self._bg:
             t.cancel()
+        if self.persist_path and self._dirty:
+            # Final flush BEFORE closing the server: acknowledged mutations
+            # must survive a graceful stop, and the close below triggers
+            # disconnect churn (node-dead, driver-exit) that must NOT be
+            # persisted as real state. Crashes can still lose <0.25s.
+            try:
+                self._write_snapshot()
+                self._dirty = False
+            except Exception:
+                logger.exception("final controller snapshot failed")
         await self.server.close()
 
     def _event(self, kind: str, **kw):
         self.events.append({"ts": time.time(), "kind": kind, **kw})
+        self._dirty = True
         if len(self.events) > self.config.event_buffer_size:
             del self.events[: len(self.events) // 2]
+
+    # -- persistence (control-plane fault tolerance) --------------------
+    async def _snapshot_loop(self):
+        while True:
+            await asyncio.sleep(0.25)
+            if self._dirty:
+                self._dirty = False
+                try:
+                    self._write_snapshot()
+                except Exception:
+                    logger.exception("controller snapshot failed")
+
+    def _write_snapshot(self):
+        import pickle
+
+        state = {
+            "kv": self.kv,
+            "jobs": self.jobs,
+            "job_counter": self._job_counter,
+            "named_actors": {k: v.binary() for k, v in self.named_actors.items()},
+            "actors": [
+                {
+                    "actor_id": a.actor_id.binary(),
+                    "spec": a.spec,
+                    "state": a.state,
+                    "node_id": a.node_id,
+                    "worker_addr": a.worker_addr,
+                    "worker_id": a.worker_id,
+                    "restarts_used": a.restarts_used,
+                    "death_cause": a.death_cause,
+                }
+                for a in self.actors.values()
+            ],
+            "pgs": [
+                {
+                    "pg_id": pg.pg_id.binary(),
+                    "bundles": [
+                        {"index": b.index, "resources": b.resources, "node_id": b.node_id, "available": b.available}
+                        for b in pg.bundles
+                    ],
+                    "strategy": pg.strategy,
+                    "state": pg.state,
+                    "name": pg.name,
+                    "job_id": pg.job_id,
+                    "label_selector": pg.label_selector,
+                }
+                for pg in self.pgs.values()
+            ],
+        }
+        tmp = f"{self.persist_path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f, protocol=5)
+        os.replace(tmp, self.persist_path)
+
+    def _restore_snapshot(self):
+        import pickle
+
+        try:
+            with open(self.persist_path, "rb") as f:
+                state = pickle.load(f)
+        except FileNotFoundError:
+            return
+        self.kv = state["kv"]
+        self.jobs = state["jobs"]
+        self._job_counter = state["job_counter"]
+        for rec in state["actors"]:
+            if rec["state"] == DEAD:
+                continue  # tombstones need no recovery
+            r = ActorRecord(
+                actor_id=ActorID(rec["actor_id"]),
+                spec=rec["spec"],
+                state=rec["state"],
+                node_id=rec["node_id"],
+                worker_addr=rec["worker_addr"],
+                worker_id=rec["worker_id"],
+                restarts_used=rec["restarts_used"],
+                death_cause=rec["death_cause"],
+            )
+            self.actors[r.actor_id] = r
+            if r.state in (ALIVE, RESTARTING):
+                self._unconfirmed_actors.add(r.actor_id)
+            elif r.state == PENDING:
+                self.pending_actors.append(r)
+        # Only name entries whose records were restored: DEAD tombstones are
+        # dropped above, and a dangling name would KeyError every lookup.
+        self.named_actors = {
+            k: ActorID(v) for k, v in state["named_actors"].items() if ActorID(v) in self.actors
+        }
+        for rec in state["pgs"]:
+            pg = PGRecord(
+                pg_id=PlacementGroupID(rec["pg_id"]),
+                bundles=[
+                    BundleState(b["index"], dict(b["resources"]), node_id=b["node_id"], available=dict(b["available"]))
+                    for b in rec["bundles"]
+                ],
+                strategy=rec["strategy"],
+                state=rec["state"],
+                name=rec["name"],
+                job_id=rec["job_id"],
+                label_selector=rec["label_selector"],
+            )
+            self.pgs[pg.pg_id] = pg
+        self._reconcile_deadline = time.monotonic() + self.config.controller_reconcile_grace_s
+        logger.info(
+            "controller restored: %d actors (%d unconfirmed), %d PGs, %d KV namespaces",
+            len(self.actors), len(self._unconfirmed_actors), len(self.pgs), len(self.kv),
+        )
 
     # -- pubsub ---------------------------------------------------------
     def handle_subscribe(self, conn, p):
@@ -206,7 +342,10 @@ class Controller:
                 self._release_leases_of(c)
                 if role == "daemon":
                     node_id = c.meta.get("node_id")
-                    if node_id in self.nodes:
+                    # Stale-close guard: a daemon that already redialed and
+                    # re-registered has a NEW conn — this close event must not
+                    # kill the fresh registration.
+                    if node_id in self.nodes and self.nodes[node_id].conn is c:
                         asyncio.create_task(self._on_node_dead(node_id, "daemon disconnected"))
                 elif role == "driver":
                     asyncio.create_task(self._on_driver_exit(c.meta.get("job_id")))
@@ -229,6 +368,36 @@ class Controller:
         )
         conn.meta.update(role="daemon", node_id=p["node_id"])
         self.nodes[p["node_id"]] = node
+        # Re-registration after a controller restart: the daemon reports its
+        # resident objects and live actors so the directory and actor FSMs
+        # re-converge (reference: GCS FT — raylets resend their state on
+        # RayletNotifyGCSRestart).
+        for oid_bin, size in p.get("objects", []):
+            self.object_dir.setdefault(oid_bin, set()).add(p["node_id"])
+            self.object_sizes[oid_bin] = size
+        # Restored CREATED placement groups re-consume their bundles on this
+        # node (bundle reservations survive the control-plane restart).
+        for pg in self.pgs.values():
+            if pg.state == "CREATED":
+                for b in pg.bundles:
+                    if b.node_id == p["node_id"]:
+                        _sub(node.resources_available, b.resources)
+        for rec in p.get("actors", []):
+            record = self.actors.get(ActorID(rec["actor_id"]))
+            if record is None:
+                continue
+            record.node_id = p["node_id"]
+            record.worker_addr = rec["worker_addr"]
+            record.worker_id = rec["worker_id"]
+            if record.state != DEAD:
+                record.state = ALIVE
+                self._wake_actor_waiters(record)
+            self._unconfirmed_actors.discard(record.actor_id)
+            # A live actor consumes its demand on the re-registered node
+            # (unless it is inside a PG bundle, already accounted above).
+            strategy = record.spec.options.scheduling_strategy
+            if getattr(strategy, "kind", "") != "PLACEMENT_GROUP":
+                _sub(node.resources_available, record.spec.options.resource_demand())
         self._event("node_alive", node_id=p["node_id"], resources=p["resources"])
         self.publish("node", p["node_id"], {"state": "ALIVE", "address": p["address"]})
         await self._retry_pending()
@@ -291,6 +460,17 @@ class Controller:
             for nid, node in list(self.nodes.items()):
                 if node.state == "ALIVE" and now - node.last_heartbeat > self.config.heartbeat_timeout_s:
                     await self._on_node_dead(nid, "heartbeat timeout")
+            # Post-restore grace expired: restored-ALIVE actors whose node
+            # never re-registered get the worker-died treatment (restart FSM
+            # decides restart vs DEAD from max_restarts).
+            if self._reconcile_deadline is not None and now >= self._reconcile_deadline:
+                self._reconcile_deadline = None
+                for actor_id in list(self._unconfirmed_actors):
+                    record = self.actors.get(actor_id)
+                    self._unconfirmed_actors.discard(actor_id)
+                    if record is not None and record.state in (ALIVE, RESTARTING):
+                        record.node_id = ""  # placement is stale; don't credit resources back
+                        await self._on_actor_worker_died(record, "not re-confirmed after controller restart")
 
     async def _on_node_dead(self, node_id: str, reason: str):
         node = self.nodes.get(node_id)
@@ -335,6 +515,13 @@ class Controller:
 
     # -- job management -------------------------------------------------
     def handle_register_job(self, conn, p):
+        existing = p.get("job_id")
+        if existing is not None and JobID(existing).hex() in self.jobs:
+            # Driver reconnecting after a controller restart: keep its job.
+            job_id = JobID(existing)
+            conn.meta.update(role="driver", job_id=job_id.hex())
+            self.jobs[job_id.hex()]["state"] = "RUNNING"
+            return {"job_id": job_id.binary(), "config": self.config.to_dict(), "nodes": self._node_table()}
         self._job_counter += 1
         job_id = JobID.from_int(self._job_counter)
         conn.meta.update(role="driver", job_id=job_id.hex())
@@ -348,6 +535,7 @@ class Controller:
         exists = p["key"] in ns
         if not exists or p.get("overwrite", True):
             ns[p["key"]] = p["value"]
+            self._dirty = True
         return not exists
 
     def handle_kv_get(self, conn, p):
@@ -358,7 +546,9 @@ class Controller:
         return {k: ns.get(k) for k in p["keys"]}
 
     def handle_kv_del(self, conn, p):
-        return self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None
+        removed = self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None
+        self._dirty = self._dirty or removed
+        return removed
 
     def handle_kv_keys(self, conn, p):
         prefix = p.get("prefix", "")
@@ -633,6 +823,9 @@ class Controller:
             await self._schedule_actor(record)
 
     async def _on_actor_worker_died(self, record: ActorRecord, reason: str):
+        # Any death/restart handling confirms the record is live-tracked again
+        # — the post-restore grace check must not fire a second death on it.
+        self._unconfirmed_actors.discard(record.actor_id)
         if record.state == DEAD:
             return
         self._restore(record.node_id, record.spec.options.resource_demand(), record.spec.options.scheduling_strategy)
@@ -693,6 +886,7 @@ class Controller:
         return True
 
     async def _kill_actor(self, record: ActorRecord, reason: str, no_restart: bool):
+        self._unconfirmed_actors.discard(record.actor_id)
         if record.state == DEAD:
             return
         node = self.nodes.get(record.node_id)

@@ -387,6 +387,25 @@ int store_evict(void* sv, uint64_t nbytes, uint8_t* out_ids, uint32_t max_ids) {
   return (int)n;
 }
 
+// List sealed objects: ids (kIdSize each) + sizes. Returns count written.
+// Used to rebuild the object directory when a node re-registers after a
+// control-plane restart (reference: GCS FT resource/object view rebuild).
+int store_list(void* sv, uint8_t* out_ids, uint64_t* out_sizes, uint32_t max_ids) {
+  Store* s = reinterpret_cast<Store*>(sv);
+  Header* h = s->hdr;
+  Guard g(h);
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < kMaxObjects && n < max_ids; i++) {
+    Entry* e = &h->table[i];
+    if (e->state == kSealed) {
+      memcpy(out_ids + (uint64_t)n * kIdSize, e->id, kIdSize);
+      out_sizes[n] = e->size;
+      n++;
+    }
+  }
+  return (int)n;
+}
+
 uint64_t store_capacity(void* sv) { return reinterpret_cast<Store*>(sv)->hdr->capacity; }
 uint64_t store_used(void* sv) { return reinterpret_cast<Store*>(sv)->hdr->used; }
 uint64_t store_num_objects(void* sv) { return reinterpret_cast<Store*>(sv)->hdr->num_objects; }

@@ -96,18 +96,14 @@ class NodeDaemon:
             spill_dir=self.config.object_spill_dir or None,
         )
         self.address = await self.server.start(port)
-        self.controller = await rpc.connect(self.controller_addr, handler=self, timeout=self.config.rpc_connect_timeout_s)
-        reply = await self.controller.call(
-            "register_node",
-            {
-                "node_id": self.node_id,
-                "address": self.address,
-                "resources": self.resources,
-                "labels": self.labels,
-                "store_path": self.store_path,
-            },
+        # Persistent link: survives controller restarts — every (re)dial
+        # replays registration, carrying live actors + resident objects so a
+        # restored control plane re-converges (reference: raylet reconnect on
+        # RayletNotifyGCSRestart, core_worker.proto:475).
+        self.controller = rpc.PersistentConnection(
+            self.controller_addr, handler=self, on_reconnect=self._register_with_controller
         )
-        self.config = Config.from_dict(reply["config"])
+        await self.controller.ensure()
         self._bg.append(asyncio.create_task(self._heartbeat_loop()))
         self._bg.append(asyncio.create_task(self._idle_reaper_loop()))
         logger.info("node daemon %s on %s (store %s)", self.node_id[:8], self.address, self.store_path)
@@ -132,6 +128,35 @@ class NodeDaemon:
                 import shutil
 
                 shutil.rmtree(spill_dir, ignore_errors=True)
+
+    async def _register_with_controller(self, conn):
+        objects = [(oid.binary(), size) for oid, size in self.store.list_objects()]
+        if self.store.spill_dir and os.path.isdir(self.store.spill_dir):
+            for fname in os.listdir(self.store.spill_dir):
+                try:
+                    oid = ObjectID(bytes.fromhex(fname))
+                except ValueError:
+                    continue
+                objects.append((oid.binary(), os.path.getsize(os.path.join(self.store.spill_dir, fname))))
+        actors = [
+            {"actor_id": aid, "worker_addr": w.address, "worker_id": w.worker_id}
+            for w in self.workers.values()
+            if w.state == "ACTOR" and w.conn and not w.conn.closed
+            for aid in w.actor_ids
+        ]
+        reply = await conn.call(
+            "register_node",
+            {
+                "node_id": self.node_id,
+                "address": self.address,
+                "resources": self.resources,
+                "labels": self.labels,
+                "store_path": self.store_path,
+                "objects": objects,
+                "actors": actors,
+            },
+        )
+        self.config = Config.from_dict(reply["config"])
 
     async def _heartbeat_loop(self):
         while True:

@@ -50,6 +50,10 @@ class _Lib:
                 lib.store_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32]
                 lib.store_evict_candidates.restype = ctypes.c_int
                 lib.store_evict_candidates.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32]
+                lib.store_list.restype = ctypes.c_int
+                lib.store_list.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint32,
+                ]
                 for fn in ("store_capacity", "store_used", "store_num_objects"):
                     getattr(lib, fn).restype = ctypes.c_uint64
                     getattr(lib, fn).argtypes = [ctypes.c_void_p]
@@ -265,6 +269,17 @@ class SharedMemoryClient:
         buf = ctypes.create_string_buffer(_ID_SIZE * max_ids)
         n = self._lib.store_evict(self._h, nbytes, buf, max_ids)
         return [ObjectID(buf.raw[i * _ID_SIZE : (i + 1) * _ID_SIZE]) for i in range(n)]
+
+    def list_objects(self, max_ids: int = 65536) -> list[tuple[ObjectID, int]]:
+        """(id, size) of every sealed resident object; add is_spilled files
+        separately if needed."""
+        ids = ctypes.create_string_buffer(_ID_SIZE * max_ids)
+        sizes = (ctypes.c_uint64 * max_ids)()
+        n = self._lib.store_list(self._h, ids, sizes, max_ids)
+        return [
+            (ObjectID(ids.raw[i * _ID_SIZE : (i + 1) * _ID_SIZE]), int(sizes[i]))
+            for i in range(n)
+        ]
 
     @property
     def capacity(self) -> int:

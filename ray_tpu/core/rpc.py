@@ -227,6 +227,83 @@ class RpcServer:
                 pass
 
 
+class PersistentConnection:
+    """A Connection that transparently redials on loss and replays a
+    registration handshake (``on_reconnect``) after each redial.
+
+    Used for the long-lived links to the controller: daemons/drivers survive a
+    controller restart (reference: GCS fault tolerance — raylets reconnect on
+    RayletNotifyGCSRestart, core_worker.proto:475; here reconnection is
+    detected by the TCP close + retried dial). Calls that were in flight when
+    the link dropped raise ConnectionLost to THEIR caller (no blind replay of
+    possibly non-idempotent operations); subsequent calls redial.
+    """
+
+    def __init__(self, addr: str, handler: Any = None, on_reconnect=None,
+                 dial_timeout: float = 5.0, give_up_after: float = 120.0):
+        self.addr = addr
+        self.handler = handler
+        self.on_reconnect = on_reconnect
+        self.dial_timeout = dial_timeout
+        self.give_up_after = give_up_after
+        self._conn: Connection | None = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+        self.meta: dict = {}
+
+    async def _ensure(self) -> Connection:
+        if self._closed:
+            raise ConnectionLost(f"persistent connection to {self.addr} closed")
+        if self._conn is not None and not self._conn.closed:
+            return self._conn
+        async with self._lock:
+            if self._conn is not None and not self._conn.closed:
+                return self._conn
+            deadline = time.monotonic() + self.give_up_after
+            attempt = 0
+            while True:
+                if self._closed:
+                    raise ConnectionLost(f"persistent connection to {self.addr} closed")
+                conn = None
+                try:
+                    conn = await connect(self.addr, handler=self.handler, timeout=self.dial_timeout, retry=False)
+                    if self.on_reconnect is not None:
+                        await self.on_reconnect(conn)
+                    self._conn = conn
+                    return conn
+                except Exception as e:
+                    if conn is not None:  # dialed but handshake failed: don't leak it
+                        try:
+                            await conn.close()
+                        except Exception:
+                            pass
+                    attempt += 1
+                    if time.monotonic() > deadline:
+                        raise ConnectionLost(f"cannot re-establish {self.addr}: {e}") from e
+                    await asyncio.sleep(min(0.05 * attempt, 1.0))
+
+    async def ensure(self) -> Connection:
+        """Dial (and run the handshake) now; returns the live Connection."""
+        return await self._ensure()
+
+    async def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
+        conn = await self._ensure()
+        return await conn.call(method, payload, timeout)
+
+    async def notify(self, method: str, payload: Any = None):
+        conn = await self._ensure()
+        await conn.notify(method, payload)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def close(self):
+        self._closed = True
+        if self._conn is not None:
+            await self._conn.close()
+
+
 async def connect(addr: str, handler: Any = None, timeout: float = 10.0, retry: bool = True) -> Connection:
     kind_parts = parse_addr(addr)
     deadline = time.monotonic() + timeout

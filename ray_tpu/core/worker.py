@@ -282,11 +282,15 @@ class CoreWorker:
 
     async def _async_init(self, ready: threading.Event | None = None):
         self.address = await self.server.start()
-        self.controller = await rpc.connect(self.controller_addr, handler=self, timeout=self.config.rpc_connect_timeout_s)
+        # Persistent controller link: a controller restart redials and (for
+        # drivers) re-registers the job, keeping the same job id (reference:
+        # GCS FT — clients reconnect after GCS restart).
+        self.controller = rpc.PersistentConnection(
+            self.controller_addr, handler=self, on_reconnect=self._controller_handshake
+        )
+        await self.controller.ensure()
         if self.mode == "driver":
-            reply = await self.controller.call("register_job", {"driver_addr": self.address})
-            self.job_id = JobID(reply["job_id"])
-            self.config = Config.from_dict(reply["config"])
+            reply = self._register_reply
             nodes = reply["nodes"]
             # Attach to a local daemon's store if one exists on this host.
             for nid, info in nodes.items():
@@ -311,6 +315,17 @@ class CoreWorker:
         self._bg.append(asyncio.create_task(self._reaper_loop()))
         if ready is not None:
             ready.set()
+
+    async def _controller_handshake(self, conn):
+        if self.mode != "driver":
+            return  # workers register with their daemon, not the controller
+        payload = {"driver_addr": self.address}
+        if not self.job_id.is_nil():
+            payload["job_id"] = self.job_id.binary()  # reconnect: keep the job
+        reply = await conn.call("register_job", payload)
+        self.job_id = JobID(reply["job_id"])
+        self.config = Config.from_dict(reply["config"])
+        self._register_reply = reply
 
     def attach_loop(self, loop: asyncio.AbstractEventLoop):
         self.loop = loop
