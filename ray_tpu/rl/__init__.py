@@ -1,0 +1,13 @@
+"""ray_tpu.rl: RL training on the actor/task runtime (RLlib-equivalent seed).
+
+Role-equivalent to the reference's RLlib core split (rllib/):
+- EnvRunnerGroup (env/env_runner_group.py) -> EnvRunner actors collecting
+  rollouts from gymnasium vector envs with numpy policy forwards;
+- LearnerGroup (core/learner/learner_group.py:101) -> a jitted JAX PPO
+  learner (gang interface; DP over a mesh composes via ray_tpu.parallel);
+- Algorithm (algorithms/algorithm.py) -> PPO driver: broadcast weights,
+  parallel sample, GAE, minibatched clipped-surrogate updates.
+"""
+from ray_tpu.rl.ppo import PPO, PPOConfig
+
+__all__ = ["PPO", "PPOConfig"]

@@ -1,0 +1,81 @@
+"""PPO Learner: jitted clipped-surrogate updates.
+
+Role-equivalent to the reference's Learner/LearnerGroup
+(rllib/core/learner/learner.py:112, learner_group.py:101) with the torch-DDP
+data parallelism replaced by the JAX-native story: the update is one jitted
+function of (params, opt_state, minibatch) — scaling it over a device mesh is
+a sharding annotation, not a distribution framework.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rl.module import jax_logits_values
+
+
+class PPOLearner:
+    def __init__(self, params: dict, lr: float = 3e-4, clip: float = 0.2,
+                 vf_coef: float = 0.5, ent_coef: float = 0.01, max_grad_norm: float = 0.5):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(max_grad_norm),
+            optax.adam(lr, eps=1e-5),
+        )
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def loss_fn(p, batch):
+            logits, values = jax_logits_values(p, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["advantages"]
+            pg = -jnp.minimum(ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
+            vf = 0.5 * ((values - batch["returns"]) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(axis=1).mean()
+            total = pg + vf_coef * vf - ent_coef * entropy
+            return total, {"pg_loss": pg, "vf_loss": vf, "entropy": entropy}
+
+        def update(p, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, p)
+            p = optax.apply_updates(p, updates)
+            aux["loss"] = loss
+            return p, opt_state, aux
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def update_minibatch(self, batch: dict) -> dict:
+        self.params, self.opt_state, aux = self._update(self.params, self.opt_state, batch)
+        return aux
+
+    def get_weights(self) -> dict:
+        import jax
+
+        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
+
+
+def compute_gae(rewards, values, dones, terms, last_values, gamma: float, lam: float):
+    """GAE over [T, N] rollouts.
+
+    ``dones`` (termination OR truncation) cuts the advantage chain — no
+    credit flows across episode boundaries. ``terms`` (true termination only)
+    zeroes the value bootstrap; a time-limit TRUNCATION still bootstraps
+    gamma*V(final_obs) — in next-step autoreset mode values[t+1] IS
+    V(final_obs), so the recursion's next_values provides it for free.
+    Conflating the two underestimates values near the time limit.
+    """
+    T, N = rewards.shape
+    adv = np.zeros((T, N), np.float32)
+    last_gae = np.zeros(N, np.float32)
+    next_values = last_values
+    for t in range(T - 1, -1, -1):
+        delta = rewards[t] + gamma * next_values * (1.0 - terms[t]) - values[t]
+        last_gae = delta + gamma * lam * (1.0 - dones[t]) * last_gae
+        adv[t] = last_gae
+        next_values = values[t]
+    returns = adv + values
+    return adv, returns

@@ -1,0 +1,80 @@
+"""Policy/value module: one param pytree, two forwards.
+
+The learner differentiates a JAX forward; env runners (separate worker
+processes) run the same tiny MLP in numpy — no per-worker JAX runtime, no
+device contention with the learner (reference: RLModule with framework-
+specific forwards, rllib/core/rl_module/).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def init_params(rng: np.random.Generator, obs_dim: int, n_actions: int, hidden=(64, 64)) -> dict:
+    """Orthogonal init, SEPARATE actor and critic MLPs (a shared trunk lets
+    the large-magnitude value-regression gradients drown the policy gradient
+    — the standard separate-networks PPO choice for control tasks). Plain
+    numpy dict so it ships through the object store and converts to jax on
+    the learner."""
+    def dense(fan_in, fan_out, scale):
+        w = rng.standard_normal((fan_in, fan_out)).astype(np.float32)
+        q, _ = np.linalg.qr(w) if fan_in >= fan_out else np.linalg.qr(w.T)
+        q = q if fan_in >= fan_out else q.T
+        return (scale * q[:fan_in, :fan_out]).astype(np.float32), np.zeros(fan_out, np.float32)
+
+    params = {}
+    for prefix in ("p", "v"):  # policy / value towers
+        d = obs_dim
+        for i, h in enumerate(hidden):
+            params[f"{prefix}w{i}"], params[f"{prefix}b{i}"] = dense(d, h, np.sqrt(2.0))
+            d = h
+    params["wpi"], params["bpi"] = dense(hidden[-1], n_actions, 0.01)
+    params["wvf"], params["bvf"] = dense(hidden[-1], 1, 1.0)
+    return params
+
+
+def n_hidden(params) -> int:
+    return sum(1 for k in params if k.startswith("pw"))
+
+
+def _np_trunk(params, obs, prefix):
+    h = obs
+    for i in range(n_hidden(params)):
+        h = np.tanh(h @ params[f"{prefix}w{i}"] + params[f"{prefix}b{i}"])
+    return h
+
+
+def np_logits_values(params, obs):
+    """obs [N, obs_dim] -> (logits [N, A], values [N]). numpy, runner-side."""
+    logits = _np_trunk(params, obs, "p") @ params["wpi"] + params["bpi"]
+    values = (_np_trunk(params, obs, "v") @ params["wvf"] + params["bvf"])[:, 0]
+    return logits, values
+
+
+def np_sample(params, obs, rng: np.random.Generator):
+    """Sample actions (vectorized Gumbel-max categorical draw — one numpy op
+    instead of a per-env Python loop in the rollout hot path); returns
+    (actions, logp, values)."""
+    logits, values = np_logits_values(params, obs)
+    gumbel = rng.gumbel(size=logits.shape).astype(np.float32)
+    actions = np.argmax(logits + gumbel, axis=1).astype(np.int64)
+    z = logits - logits.max(axis=1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=1, keepdims=True)
+    logp = np.log(p[np.arange(len(actions)), actions] + 1e-10).astype(np.float32)
+    return actions, logp, values.astype(np.float32)
+
+
+def jax_logits_values(params, obs):
+    """Same math in jax (learner-side, differentiable)."""
+    import jax.numpy as jnp
+
+    def trunk(prefix):
+        h = obs
+        for i in range(n_hidden(params)):
+            h = jnp.tanh(h @ params[f"{prefix}w{i}"] + params[f"{prefix}b{i}"])
+        return h
+
+    logits = trunk("p") @ params["wpi"] + params["bpi"]
+    values = (trunk("v") @ params["wvf"] + params["bvf"])[:, 0]
+    return logits, values
