@@ -1,0 +1,174 @@
+"""`python -m ray_tpu` — cluster state CLI.
+
+Role-equivalent to the reference's `ray status` / `ray list ...` state CLI
+(python/ray/util/state, scripts/): connects to a running cluster by address
+(--address or RAYTPU_ADDRESS) and prints tables of nodes/actors/PGs/jobs,
+events, metrics, or submits/inspects jobs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _connect(address: str | None):
+    import ray_tpu as rt
+
+    addr = address or os.environ.get("RAYTPU_ADDRESS")
+    if not addr:
+        print("error: no --address and RAYTPU_ADDRESS unset", file=sys.stderr)
+        sys.exit(2)
+    rt.init(address=addr)
+    return rt
+
+
+def _state(rt):
+    from ray_tpu.core import api
+
+    return api._cluster_state()
+
+
+def _rows(title, header, rows):
+    print(f"== {title} ==")
+    if not rows:
+        print("  (none)")
+        return
+    widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
+    for r in [header] + rows:
+        print("  " + "  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+
+
+def cmd_status(args):
+    rt = _connect(args.address)
+    s = _state(rt)
+    nodes = s["nodes"]
+    alive = [n for n in nodes.values() if n["state"] == "ALIVE"]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    total: dict = {}
+    avail: dict = {}
+    for n in alive:
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0) + v
+        for k, v in n["resources_available"].items():
+            avail[k] = avail.get(k, 0) + v
+    for k in sorted(total):
+        print(f"  {k}: {total[k] - avail.get(k, 0):g}/{total[k]:g} used")
+    print(f"actors: {sum(1 for a in s['actors'].values() if a['state'] == 'ALIVE')} alive")
+    print(f"placement groups: {len(s['placement_groups'])}")
+    print(f"objects tracked: {s['objects']['count']} ({s['objects']['bytes'] / 1e6:.1f} MB)")
+
+
+def cmd_list(args):
+    rt = _connect(args.address)
+    s = _state(rt)
+    kind = args.kind
+    if kind == "nodes":
+        _rows("nodes", ["node_id", "state", "address", "resources"], [
+            [nid[:12], n["state"], n["address"], json.dumps(n["resources_total"])]
+            for nid, n in s["nodes"].items()
+        ])
+    elif kind == "actors":
+        _rows("actors", ["actor_id", "state", "name", "node", "restarts"], [
+            [aid[:12], a["state"], a["name"] or "-", (a["node_id"] or "-")[:12], a["restarts"]]
+            for aid, a in s["actors"].items()
+        ])
+    elif kind == "pgs":
+        _rows("placement groups", ["pg_id", "state", "strategy", "bundles"], [
+            [pid[:12], g["state"], g["strategy"], len(g["bundles"])]
+            for pid, g in s["placement_groups"].items()
+        ])
+    elif kind == "jobs":
+        from ray_tpu.job import JobSubmissionClient
+
+        _rows("jobs", ["job_id", "status", "entrypoint"], [
+            [j["job_id"], j["status"], j["entrypoint"][:48]] for j in JobSubmissionClient().list_jobs()
+        ])
+
+
+def cmd_events(args):
+    rt = _connect(args.address)
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    for e in core._run(core.controller.call("get_events", {"limit": args.limit})):
+        print(json.dumps(e, default=str))
+
+
+def cmd_metrics(args):
+    rt = _connect(args.address)
+    from ray_tpu.core import api
+    from ray_tpu.util.metrics import prometheus_text
+
+    core = api._require_worker()
+    series = core._run(core.controller.call("get_metrics", {}))
+    print(prometheus_text(series))
+
+
+def cmd_job(args):
+    rt = _connect(args.address)
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(args.entrypoint)
+        print(job_id)
+        if args.wait:
+            print(client.wait_until_finished(job_id))
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.job_id))
+
+
+def cmd_dashboard(args):
+    rt = _connect(args.address)
+    from ray_tpu.dashboard import start_dashboard
+
+    port = start_dashboard(args.port)
+    print(f"dashboard at http://127.0.0.1:{port}/ (ctrl-c to stop)")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    p.add_argument("--address", default=None, help="controller address host:port")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    lp = sub.add_parser("list")
+    lp.add_argument("kind", choices=["nodes", "actors", "pgs", "jobs"])
+    ep = sub.add_parser("events")
+    ep.add_argument("--limit", type=int, default=100)
+    sub.add_parser("metrics")
+    jp = sub.add_parser("job")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("entrypoint")
+    js.add_argument("--wait", action="store_true")
+    for name in ("status", "logs", "stop"):
+        x = jsub.add_parser(name)
+        x.add_argument("job_id")
+    dp = sub.add_parser("dashboard")
+    dp.add_argument("--port", type=int, default=8265)
+    args = p.parse_args(argv)
+    {
+        "status": cmd_status,
+        "list": cmd_list,
+        "events": cmd_events,
+        "metrics": cmd_metrics,
+        "job": cmd_job,
+        "dashboard": cmd_dashboard,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

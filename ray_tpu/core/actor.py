@@ -22,6 +22,13 @@ class ActorMethod:
     def options(self, num_returns: int = 1):
         return ActorMethod(self._handle, self._name, num_returns)
 
+    def bind(self, *args):
+        """Capture this call as a compiled-DAG node (ray_tpu.dag; reference:
+        dag/dag_node.py bind)."""
+        from ray_tpu.dag.graph import DAGNode
+
+        return DAGNode(self._handle, self._name, args)
+
     def remote(self, *args, **kwargs):
         from ray_tpu.core import api
 

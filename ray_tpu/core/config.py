@@ -54,6 +54,11 @@ class Config:
     # --- scheduling ---
     scheduler_spread_threshold: float = 0.5
     max_pending_lease_requests_per_key: int = 10
+    # With an autoscaler attached, currently-infeasible demand must PARK (the
+    # autoscaler provisions a node for it) instead of failing fast — the
+    # reference always parks and warns; fast-fail is this framework's default
+    # for static clusters.
+    infeasible_as_pending: bool = False
     # --- actors ---
     actor_creation_timeout_s: float = 60.0
     max_actor_restarts_default: int = 0

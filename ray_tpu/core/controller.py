@@ -451,6 +451,69 @@ class Controller:
     def handle_get_events(self, conn, p):
         return self.events[-int(p.get("limit", 1000)):]
 
+    def handle_get_autoscaler_state(self, conn, p):
+        """Pending demand + per-node load for the autoscaler (reference:
+        GcsAutoscalerStateManager feeding autoscaler.proto's
+        ClusterResourceState — pending resource requests / gang requests)."""
+        # Bundle-bound (PLACEMENT_GROUP) and node-affinity leases can only run
+        # on their fixed target — a new node can never host them, so they are
+        # not autoscaler demand (the PG's capacity shows up via pending_gangs).
+        pending = [
+            {"demand": pl.demand, "label_selector": pl.label_selector, "kind": "lease"}
+            for pl in self.pending_leases
+            if getattr(pl.strategy, "kind", "DEFAULT") not in ("PLACEMENT_GROUP", "NODE_AFFINITY")
+        ]
+        for record in self.pending_actors:
+            pending.append({
+                "demand": record.spec.options.resource_demand(),
+                "label_selector": record.spec.options.label_selector,
+                "kind": "actor",
+            })
+        gang = [
+            {"bundles": [b.resources for b in pg.bundles], "strategy": pg.strategy,
+             "label_selector": pg.label_selector}
+            for pg in self.pgs.values()
+            if pg.state == "PENDING"
+        ]
+        return {
+            "pending": pending,
+            "pending_gangs": gang,
+            "nodes": self._node_table(),
+        }
+
+    # -- metrics aggregation (ray.util.metrics equivalent pipeline) ------
+    def handle_report_metrics(self, conn, p):
+        if not hasattr(self, "metrics_by_reporter"):
+            self.metrics_by_reporter = {}
+        self.metrics_by_reporter[p["reporter"]] = (time.monotonic(), p["series"])
+        return True
+
+    def handle_get_metrics(self, conn, p):
+        """Merged view across LIVE reporters (entries older than 3 report
+        intervals are dropped — dead workers must not contribute stale gauges
+        or leak controller memory). Counters/histograms sum; gauges sum;
+        histograms merge only when bucket boundaries match (mismatched
+        boundaries keep separate series instead of corrupting counts)."""
+        now = time.monotonic()
+        horizon = 3 * self.config.metrics_report_interval_s + 5.0
+        reporters = getattr(self, "metrics_by_reporter", {})
+        for rid in [r for r, (ts, _) in reporters.items() if now - ts > horizon]:
+            del reporters[rid]
+        merged: dict[tuple, dict] = {}
+        for _ts, series in reporters.values():
+            for rec in series:
+                key = (rec["name"], tuple(sorted(rec["tags"].items())), tuple(rec.get("buckets") or ()))
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = dict(rec)
+                elif rec["kind"] == "histogram" and cur.get("counts") and rec.get("counts"):
+                    cur["counts"] = [a + b for a, b in zip(cur["counts"], rec["counts"])]
+                    cur["sum"] += rec["sum"]
+                    cur["n"] += rec["n"]
+                else:
+                    cur["value"] += rec["value"]
+        return list(merged.values())
+
     async def _health_check_loop(self):
         # Reference: GcsHealthCheckManager gRPC-probes raylets; here liveness
         # is daemon->controller heartbeats plus TCP connection state.
@@ -649,7 +712,11 @@ class Controller:
             self._consume(node, demand, strategy)
             self.leases[p["lease_id"]] = (node.node_id, demand, strategy, conn)
             return {"node_id": node.node_id, "address": node.address, "store_path": node.store_path, "strategy": strategy}
-        if not self._feasible_nodes(demand, p.get("label_selector", {})) and getattr(strategy, "kind", "") != "PLACEMENT_GROUP":
+        if (
+            not self.config.infeasible_as_pending
+            and not self._feasible_nodes(demand, p.get("label_selector", {}))
+            and getattr(strategy, "kind", "") != "PLACEMENT_GROUP"
+        ):
             return {"infeasible": True}
         fut = asyncio.get_running_loop().create_future()
         pl = PendingLease(p["lease_id"], demand, strategy, p.get("label_selector", {}), fut)

@@ -331,10 +331,27 @@ class CoreWorker:
         self.loop = loop
 
     async def _reaper_loop(self):
+        last_metrics = 0.0
         while not self._shutdown:
             await asyncio.sleep(0.5)
             for sub in list(self._submitters.values()):
                 await sub.reap_idle(linger_s=2.0)
+            now = time.monotonic()
+            if now - last_metrics >= self.config.metrics_report_interval_s:
+                last_metrics = now
+                await self._report_metrics()
+
+    async def _report_metrics(self):
+        """Ship this process's metric series to the controller (reference:
+        per-node agent scrape -> dashboard; here a direct push)."""
+        try:
+            from ray_tpu.util import metrics as _m
+
+            series = _m.snapshot()
+            if series:
+                await self.controller.notify("report_metrics", {"reporter": self.worker_id, "series": series})
+        except Exception:
+            pass
 
     def shutdown_sync(self):
         if self._shutdown or self.loop is None:
@@ -1298,6 +1315,23 @@ class CoreWorker:
         if self._actor_runtime is None:
             raise rpc.RpcError("no actor hosted on this worker")
         return await self._actor_runtime.execute(p["spec"])
+
+    # -- compiled DAG stages (ray_tpu.dag; channels ride the existing peer
+    # connections — reference: compiled_dag_node.py exec loops + channels) --
+    def handle_dag_setup(self, conn, p):
+        from ray_tpu.dag.runtime import dag_setup
+
+        return dag_setup(self, p)
+
+    async def handle_dag_push(self, conn, p):
+        from ray_tpu.dag.runtime import dag_push
+
+        return await dag_push(self, conn, p)
+
+    def handle_dag_teardown(self, conn, p):
+        from ray_tpu.dag.runtime import dag_teardown
+
+        return dag_teardown(self, p)
 
     def handle_shutdown(self, conn, p):
         self._shutdown = True

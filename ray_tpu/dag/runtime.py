@@ -1,0 +1,128 @@
+"""Worker-side compiled-DAG runtime: stage tables + push-driven execution.
+
+Reference: the per-actor exec loops of compiled graphs
+(compiled_dag_node.py:186 ``do_exec_tasks`` + shared-memory/NCCL channels).
+Redesign: instead of a blocking loop per actor reading channels, arrival of
+the LAST input for (stage, seq) schedules the stage's method on the actor's
+executor; the result is pushed straight to the downstream workers (or the
+driver). Values move as serialized blobs over the direct worker-to-worker
+connections — never through the object store or the driver.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ActorID
+
+
+def resolve_actor_addr(core, actor_handle) -> str:
+    """Worker address hosting an actor (blocks until ALIVE)."""
+    info = core._run(core.controller.call("wait_actor_alive", {"actor_id": actor_handle._actor_id.binary()}))
+    if info is None or info["state"] == "DEAD":
+        raise RuntimeError(f"actor {actor_handle._actor_id.hex()[:8]} is not alive")
+    return info["worker_addr"]
+
+
+def install_driver_handlers(core):
+    """Give the driver's CoreWorker the dag_result handler + registry."""
+    if not hasattr(core, "_dags"):
+        core._dags = {}
+
+    if not hasattr(type(core), "handle_dag_result"):
+        def handle_dag_result(self, conn, p):
+            dag = self._dags.get(p["dag_id"])
+            if dag is not None:
+                value = serialization.deserialize(p["blob"])
+                dag._deliver(p["seq"], value)
+            return True
+
+        type(core).handle_dag_result = handle_dag_result
+
+
+def register_dag(core, dag):
+    install_driver_handlers(core)
+    core._dags[dag.dag_id] = dag
+
+
+class _StageState:
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.pending: dict[int, dict[int, Any]] = {}  # seq -> slot -> value/err
+
+
+def _dag_tables(core):
+    if not hasattr(core, "_dag_stages"):
+        core._dag_stages = {}
+    return core._dag_stages
+
+
+def dag_setup(core, spec: dict):
+    _dag_tables(core)[(spec["dag_id"], spec["stage_id"])] = _StageState(spec)
+    return True
+
+
+def dag_teardown(core, p):
+    stages = _dag_tables(core)
+    for key in [k for k in stages if k[0] == p["dag_id"]]:
+        del stages[key]
+    return True
+
+
+async def dag_push(core, conn, p):
+    """An upstream value (or error) arrived for (stage, seq, slot)."""
+    stages = _dag_tables(core)
+    st = stages.get((p["dag_id"], p["stage_id"]))
+    if st is None:
+        return False  # torn down
+    seq = p["seq"]
+    slot_map = st.pending.setdefault(seq, {})
+    slot_map[p["slot"]] = (p["blob"], p["is_error"])
+    if len(slot_map) < st.spec["n_inputs"]:
+        return True
+    del st.pending[seq]
+    asyncio.create_task(_run_stage(core, st.spec, seq, slot_map))
+    return True
+
+
+async def _run_stage(core, spec: dict, seq: int, slot_map: dict):
+    # Error propagation: any errored input short-circuits the stage.
+    err_blob = next((blob for blob, is_err in slot_map.values() if is_err), None)
+    if err_blob is not None:
+        await _emit(core, spec, seq, err_blob, is_error=True)
+        return
+    runtime = core._actor_runtime
+    try:
+        if runtime is None or runtime.spec.actor_id != ActorID(spec["actor_id"]):
+            raise RuntimeError("dag stage actor is not hosted on this worker")
+        values = {slot: serialization.deserialize(blob) for slot, (blob, _) in slot_map.items()}
+        args = [values[a[1]] if a[0] == "slot" else a[1] for a in spec["arg_layout"]]
+        method = getattr(runtime.instance, spec["method"])
+        loop = asyncio.get_running_loop()
+        if asyncio.iscoroutinefunction(method):
+            # Same max_concurrency gate as ActorRuntime.execute — pipelined
+            # seqs must not exceed the actor's declared concurrency.
+            async with runtime.sem:
+                result = await method(*args)
+        else:
+            # The actor's own pool: respects its max_concurrency semantics.
+            result = await loop.run_in_executor(runtime.pool, lambda: method(*args))
+        blob, _ = serialization.serialize(result)
+        await _emit(core, spec, seq, blob, is_error=False)
+    except BaseException as e:  # noqa: BLE001 — ships to the driver
+        err = serialization.RemoteError.from_exception(e, where=f"dag stage {spec['method']}")
+        blob, _ = serialization.serialize(err.cause if err.cause is not None else err)
+        await _emit(core, spec, seq, blob, is_error=True)
+
+
+async def _emit(core, spec: dict, seq: int, blob: bytes, is_error: bool):
+    for addr, stage, slot in spec["downstream"]:
+        conn = await core._peer_conn(addr)
+        await conn.notify(
+            "dag_push",
+            {"dag_id": spec["dag_id"], "stage_id": stage, "seq": seq, "slot": slot, "blob": blob, "is_error": is_error},
+        )
+    if spec["to_driver"]:
+        conn = await core._peer_conn(spec["to_driver"])
+        await conn.notify("dag_result", {"dag_id": spec["dag_id"], "seq": seq, "blob": blob})

@@ -1,0 +1,94 @@
+"""Dashboard: HTTP JSON API + Prometheus exposition for cluster state.
+
+Role-equivalent to the reference's dashboard head (dashboard/head.py:49 and
+its JSON module routes) minus the React frontend (an explicit non-goal,
+SURVEY §7): the same information is served as JSON plus a minimal HTML
+summary page, and /metrics serves the aggregated ray.util.metrics pipeline
+in Prometheus format for external scrapers.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_INDEX = """<!doctype html><title>ray_tpu dashboard</title>
+<h2>ray_tpu cluster</h2>
+<ul>
+<li><a href=/api/cluster>/api/cluster</a> — nodes, actors, PGs, jobs</li>
+<li><a href=/api/events>/api/events</a> — structured event log</li>
+<li><a href=/api/metrics>/api/metrics</a> — aggregated metrics (JSON)</li>
+<li><a href=/api/jobs>/api/jobs</a> — submitted jobs</li>
+<li><a href=/metrics>/metrics</a> — Prometheus exposition</li>
+</ul>"""
+
+
+def _payload(path: str):
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    if path == "/api/cluster":
+        return core._run(core.controller.call("get_cluster_state", {}))
+    if path == "/api/events":
+        return core._run(core.controller.call("get_events", {"limit": 1000}))
+    if path == "/api/metrics":
+        return core._run(core.controller.call("get_metrics", {}))
+    if path == "/api/jobs":
+        from ray_tpu.job import JobSubmissionClient
+
+        return JobSubmissionClient().list_jobs()
+    return None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # silence request logging
+        pass
+
+    def do_GET(self):
+        try:
+            if self.path == "/" or self.path == "/index.html":
+                body, ctype = _INDEX.encode(), "text/html"
+            elif self.path == "/metrics":
+                from ray_tpu.core import api
+                from ray_tpu.util.metrics import prometheus_text
+
+                core = api._require_worker()
+                series = core._run(core.controller.call("get_metrics", {}))
+                body, ctype = prometheus_text(series).encode(), "text/plain; version=0.0.4"
+            else:
+                data = _payload(self.path)
+                if data is None:
+                    self.send_error(404)
+                    return
+                body, ctype = json.dumps(data, default=str).encode(), "application/json"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except Exception as e:  # pragma: no cover — defensive
+            try:
+                self.send_error(500, str(e))
+            except Exception:
+                pass
+
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+def start_dashboard(port: int = 0, host: str = "127.0.0.1") -> int:
+    """Start the dashboard HTTP server (idempotent); returns the port."""
+    global _server
+    if _server is not None:
+        return _server.server_address[1]
+    _server = ThreadingHTTPServer((host, port), _Handler)
+    threading.Thread(target=_server.serve_forever, name="raytpu-dashboard", daemon=True).start()
+    return _server.server_address[1]
+
+
+def stop_dashboard():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

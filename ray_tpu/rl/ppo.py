@@ -105,10 +105,17 @@ class PPO:
         flat["advantages"] = (flat["advantages"] - flat["advantages"].mean()) / (flat["advantages"].std() + 1e-8)
 
         aux = {}
-        mb = min(cfg.minibatch_size, B)
-        n_mb = B // mb
+        # Fixed minibatch shape across iterations (B varies with the junk-step
+        # mask; a varying shape would retrigger XLA compilation every call):
+        # pad the permutation with resampled indices up to a multiple of mb.
+        nominal = cfg.num_env_runners * cfg.num_envs_per_runner * cfg.rollout_len
+        mb = min(cfg.minibatch_size, nominal)
+        n_mb = max(1, -(-B // mb))  # ceil
         for _ in range(cfg.epochs):
             perm = self._rng.permutation(B)
+            pad = n_mb * mb - B
+            if pad > 0:
+                perm = np.concatenate([perm, self._rng.integers(0, B, pad)])
             for k in range(n_mb):
                 idx = perm[k * mb : (k + 1) * mb]
                 aux = self.learner.update_minibatch({key: v[idx] for key, v in flat.items()})

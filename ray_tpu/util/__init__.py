@@ -1,0 +1,1 @@
+"""ray_tpu.util: user-facing utilities (metrics, state API)."""

@@ -1,0 +1,45 @@
+"""Autoscaler: demand-driven scale-up and idle scale-down on a fake provider
+(reference analogue: autoscaler/v2/tests with FakeMultiNodeProvider). Own
+module: needs its own cluster session with infeasible_as_pending set."""
+import time
+
+import ray_tpu as rt
+
+
+def test_autoscaler_scales_up_and_down():
+    from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider, NodeType
+    from ray_tpu.core.api import Cluster, init, shutdown
+    from ray_tpu.core.config import Config
+
+    cfg = Config().apply_env()
+    cfg.infeasible_as_pending = True
+    cluster = Cluster(initialize_head=False, config=cfg)
+    cluster.add_node(num_cpus=1)
+    init(address=cluster.address, config=cfg)
+    try:
+        provider = LocalNodeProvider(cluster)
+        autoscaler = Autoscaler(
+            [NodeType("cpu4", {"CPU": 4.0}, max_workers=3)], provider, idle_timeout_s=1.0
+        )
+        # Demand exceeding the 1-CPU head: a pending lease + pending PG.
+        @rt.remote(num_cpus=4)
+        def heavy():
+            return 42
+
+        ref = heavy.remote()
+        pg = rt.placement_group([{"CPU": 4}], strategy="PACK")
+        time.sleep(0.5)  # demand lands in pending queues
+        result = autoscaler.update()
+        assert sum(result["launched"].values()) >= 1, result
+        assert rt.get(ref, timeout=120) == 42
+        assert pg.ready(timeout=30)
+        rt.remove_placement_group(pg)
+        # Drain: demand gone; idle autoscaled nodes terminate after timeout.
+        time.sleep(3.0)
+        autoscaler.update()  # arms idle timers (post-workload idle)
+        time.sleep(1.5)
+        result = autoscaler.update()
+        assert result["terminated"], result
+    finally:
+        shutdown()
+        cluster.shutdown()
