@@ -112,7 +112,9 @@ class Autoscaler:
             for n in state["nodes"].values()
             if n["state"] == "ALIVE"
         ]
-        unmet: list[tuple[dict, dict]] = []  # (demand, label_selector)
+        # (demand, label_selector, no_colocate_key): entries sharing a
+        # non-None key must land on DISTINCT planned nodes (STRICT_SPREAD).
+        unmet: list[tuple[dict, dict, Optional[str]]] = []
         for item in state["pending"]:
             sel = item.get("label_selector") or {}
             placed = False
@@ -122,7 +124,7 @@ class Autoscaler:
                     placed = True
                     break
             if not placed:
-                unmet.append((item["demand"], sel))
+                unmet.append((item["demand"], sel, None))
         for gang in state["pending_gangs"]:
             strategy = gang.get("strategy", "PACK")
             sel = gang.get("label_selector") or {}
@@ -138,9 +140,10 @@ class Autoscaler:
                         _consume(f, combined)
                         break
                 else:
-                    unmet.append((combined, sel))
+                    unmet.append((combined, sel, None))
                 continue
             used_idx: set[int] = set()
+            gang_key = f"gang{id(gang)}" if strategy == "STRICT_SPREAD" else None
             for b in gang["bundles"]:
                 placed = False
                 for i, (f, labels) in enumerate(frees):
@@ -152,18 +155,23 @@ class Autoscaler:
                         placed = True
                         break
                 if not placed:
-                    unmet.append((b, sel))
+                    unmet.append((b, sel, gang_key))
 
         launched: dict[str, int] = {}
         existing = self.provider.non_terminated_nodes()
         counts: dict[str, int] = {}
         for tname in existing.values():
             counts[tname] = counts.get(tname, 0) + 1
-        planned: list[tuple[dict, dict]] = []  # (free resources, labels)
-        for demand, sel in unmet:
-            for f, labels in planned:  # demand may fit on an already-planned node
+        planned: list[list] = []  # [free resources, labels, set(no_colocate keys)]
+        for demand, sel, key in unmet:
+            for node in planned:  # demand may fit on an already-planned node
+                f, labels, keys = node
+                if key is not None and key in keys:
+                    continue  # STRICT_SPREAD sibling already planned here
                 if _labels_match(labels, sel) and _fits(f, demand):
                     _consume(f, demand)
+                    if key is not None:
+                        keys.add(key)
                     break
             else:
                 for t in self.node_types.values():
@@ -178,7 +186,7 @@ class Autoscaler:
                         launched[t.name] = launched.get(t.name, 0) + 1
                         f = dict(t.resources)
                         _consume(f, demand)
-                        planned.append((f, t.labels))
+                        planned.append([f, t.labels, {key} if key is not None else set()])
                         break
         for tname, n in launched.items():
             for _ in range(n):

@@ -66,6 +66,14 @@ class Cluster:
     def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None,
                  config: Config | None = None, persist_path: str | None = None):
         self.config = config or get_config()
+        if self.config.auth_token:
+            # Opt-in per-session RPC secret (see rpc.py auth): set
+            # Config.auth_token (or RAYTPU_AUTH_TOKEN) before cluster start;
+            # it propagates to daemons (in-process), workers (env) and
+            # external drivers (config/env).
+            from ray_tpu.core import rpc as _rpc
+
+            _rpc.set_auth_token(self.config.auth_token)
         self.host = _ServiceHost()
         self.controller = Controller(self.config, persist_path=persist_path)
         self.controller_addr = self.host.call(self.controller.start())
@@ -149,6 +157,10 @@ def init(
     if _global_worker is not None:
         return {"address": _global_worker.controller_addr}
     cfg = config or get_config()
+    if cfg.auth_token:  # external driver joining an authed cluster
+        from ray_tpu.core import rpc as _rpc
+
+        _rpc.set_auth_token(cfg.auth_token)
     if address is None:
         _global_cluster = Cluster(
             initialize_head=True,

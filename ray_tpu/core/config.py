@@ -73,6 +73,14 @@ class Config:
     log_dir: str = ""
     metrics_report_interval_s: float = 5.0
     event_buffer_size: int = 10000
+    # --- security ---
+    # OPT-IN per-session shared secret for the RPC layer (pickle-over-TCP
+    # executes code on unpickle; with a token set, every frame carries an
+    # HMAC verified before unpickling). Set it (or RAYTPU_AUTH_TOKEN) before
+    # cluster start; workers/jobs inherit it via env. Empty (the default)
+    # runs WITHOUT authentication — fine for localhost dev, not for
+    # multi-host deployments.
+    auth_token: str = ""
     # --- tpu ---
     tpu_chips_per_host_default: int = 4
 

@@ -464,6 +464,9 @@ class Controller:
             if getattr(pl.strategy, "kind", "DEFAULT") not in ("PLACEMENT_GROUP", "NODE_AFFINITY")
         ]
         for record in self.pending_actors:
+            strategy = record.spec.options.scheduling_strategy
+            if getattr(strategy, "kind", "DEFAULT") in ("PLACEMENT_GROUP", "NODE_AFFINITY"):
+                continue  # bundle/node-bound: not free-form demand (see above)
             pending.append({
                 "demand": record.spec.options.resource_demand(),
                 "label_selector": record.spec.options.label_selector,

@@ -181,6 +181,8 @@ class NodeDaemon:
         env = {**os.environ, **self._spawn_env}
         env["RAYTPU_WORKER_ID"] = worker_id
         env["RAYTPU_CONTROLLER_ADDR"] = self.controller_addr
+        if self.config.auth_token:
+            env["RAYTPU_AUTH_TOKEN"] = self.config.auth_token
         env["RAYTPU_DAEMON_ADDR"] = self.address
         env["RAYTPU_STORE_PATH"] = self.store_path
         env["RAYTPU_NODE_ID"] = self.node_id

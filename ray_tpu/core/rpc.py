@@ -7,13 +7,27 @@ services, e.g. CoreWorkerService.PushTask flowing caller->callee and
 PubsubLongPolling flowing callee->caller). Frames are pickled tuples —
 small control messages only; bulk data rides the shared-memory object store.
 
-Wire format: 8-byte little-endian length, then pickle of
-  (kind, msg_id, method_or_status, payload)
+Wire format: 8-byte little-endian length, then [16-byte session tag when a
+token is set] + pickle of (kind, msg_id, method_or_status, payload).
 kind: 0=request, 1=reply, 2=notify (no reply expected).
+
+Authentication (OPT-IN): pickle-over-TCP executes arbitrary code on
+unpickle, so when a session token is installed (``set_auth_token`` — set
+``Config.auth_token`` / ``RAYTPU_AUTH_TOKEN`` before cluster start; it
+propagates to daemons/workers/jobs via config+env), EVERY frame carries a
+16-byte HMAC of its payload keyed by the token, verified constant-time
+BEFORE the payload is unpickled. Frames from peers without the token (or
+tampered frames) are dropped and the connection closed — their bytes never
+reach pickle (reference: token auth, src/ray/rpc/authentication). Stateless
+per frame: no handshake ordering to get wrong. Limitation: no replay
+nonce — an on-path attacker can replay a previously-sent frame verbatim,
+but cannot forge new payloads.
 """
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import hmac
 import itertools
 import logging
 import pickle
@@ -26,6 +40,28 @@ logger = logging.getLogger(__name__)
 
 _REQ, _REP, _NOTIFY = 0, 1, 2
 _HDR = 8
+_TAG_LEN = 16
+
+_frame_key: bytes = b""  # empty = auth disabled
+
+
+def set_auth_token(token: str | bytes | None):
+    """Install the session token for this process. Every frame sent gets an
+    HMAC(token, payload) tag prepended; every frame received must verify."""
+    global _frame_key
+    if not token:
+        _frame_key = b""
+    else:
+        raw = token.encode() if isinstance(token, str) else bytes(token)
+        _frame_key = hashlib.blake2b(raw, digest_size=32, person=b"raytpu-rpc").digest()
+
+
+def get_auth_token() -> bytes:
+    return _frame_key
+
+
+def _tag(payload: bytes) -> bytes:
+    return hmac.new(_frame_key, payload, hashlib.sha256).digest()[:_TAG_LEN]
 
 
 class RpcError(Exception):
@@ -62,6 +98,8 @@ class Connection:
 
     async def _send(self, frame: tuple):
         data = pickle.dumps(frame, protocol=5)
+        if _frame_key:
+            data = _tag(data) + data
         async with self._send_lock:
             self.writer.write(len(data).to_bytes(_HDR, "little") + data)
             await self.writer.drain()
@@ -81,6 +119,8 @@ class Connection:
         self._pending[msg_id] = fut
         fut.add_done_callback(lambda f: self._pending.pop(msg_id, None))
         data = pickle.dumps((_REQ, msg_id, method, payload), protocol=5)
+        if _frame_key:
+            data = _tag(data) + data
         self.writer.write(len(data).to_bytes(_HDR, "little") + data)
         return fut
 
@@ -112,6 +152,15 @@ class Connection:
                 hdr = await self.reader.readexactly(_HDR)
                 ln = int.from_bytes(hdr, "little")
                 data = await self.reader.readexactly(ln)
+                if _frame_key:
+                    # Constant-time per-frame HMAC check BEFORE any
+                    # unpickling; wrong/missing tag = unauthenticated or
+                    # tampered frame, drop the peer.
+                    body = memoryview(data)[_TAG_LEN:]
+                    if len(data) < _TAG_LEN or not hmac.compare_digest(data[:_TAG_LEN], _tag(body)):
+                        logger.warning("rejecting unauthenticated rpc frame from %s", self.peer_name)
+                        return
+                    data = body
                 kind, msg_id, method, payload = pickle.loads(data)
                 if kind == _REP:
                     fut = self._pending.get(msg_id)

@@ -1333,6 +1333,11 @@ class CoreWorker:
 
         return dag_teardown(self, p)
 
+    def handle_dag_result(self, conn, p):
+        from ray_tpu.dag.runtime import dag_result
+
+        return dag_result(self, p)
+
     def handle_shutdown(self, conn, p):
         self._shutdown = True
         if self._actor_runtime is not None:

@@ -22,8 +22,10 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", forced)
+    from ray_tpu.core import rpc
     from ray_tpu.core.worker import CoreWorker
 
+    rpc.set_auth_token(os.environ.get("RAYTPU_AUTH_TOKEN", ""))
     controller_addr = os.environ["RAYTPU_CONTROLLER_ADDR"]
     core = CoreWorker(mode="worker", controller_addr=controller_addr)
     loop = asyncio.new_event_loop()

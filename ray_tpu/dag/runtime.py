@@ -25,24 +25,19 @@ def resolve_actor_addr(core, actor_handle) -> str:
     return info["worker_addr"]
 
 
-def install_driver_handlers(core):
-    """Give the driver's CoreWorker the dag_result handler + registry."""
-    if not hasattr(core, "_dags"):
-        core._dags = {}
-
-    if not hasattr(type(core), "handle_dag_result"):
-        def handle_dag_result(self, conn, p):
-            dag = self._dags.get(p["dag_id"])
-            if dag is not None:
-                value = serialization.deserialize(p["blob"])
-                dag._deliver(p["seq"], value)
-            return True
-
-        type(core).handle_dag_result = handle_dag_result
+def dag_result(core, p):
+    """Driver-side: resolve the future for (dag_id, seq) (delegated from
+    CoreWorker.handle_dag_result)."""
+    dag = getattr(core, "_dags", {}).get(p["dag_id"])
+    if dag is not None:
+        value = serialization.deserialize(p["blob"])
+        dag._deliver(p["seq"], value)
+    return True
 
 
 def register_dag(core, dag):
-    install_driver_handlers(core)
+    if not hasattr(core, "_dags"):
+        core._dags = {}
     core._dags[dag.dag_id] = dag
 
 

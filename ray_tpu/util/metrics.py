@@ -116,6 +116,11 @@ def _clear():
         _registry.clear()
 
 
+def _esc(value) -> str:
+    """Escape a label value per the Prometheus exposition format."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def prometheus_text(series: list[dict]) -> str:
     """Render aggregated series in Prometheus exposition format."""
     lines = []
@@ -126,7 +131,7 @@ def prometheus_text(series: list[dict]) -> str:
             lines.append(f"# HELP {name} {rec.get('description', '')}")
             lines.append(f"# TYPE {name} {rec['kind']}")
             seen_help.add(name)
-        labels = ",".join(f'{k}="{v}"' for k, v in sorted(rec.get("tags", {}).items()))
+        labels = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(rec.get("tags", {}).items()))
         label_str = "{" + labels + "}" if labels else ""
         if rec["kind"] == "histogram":
             acc = 0
