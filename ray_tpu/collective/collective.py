@@ -11,6 +11,7 @@ touches this module.
 """
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Any, Optional
 
@@ -24,13 +25,18 @@ _process_groups: dict = {}
 
 
 class _GroupCoordinator:
-    """Named actor: mailbox per (op, round). max_concurrency lets all ranks
-    block inside gather() simultaneously."""
+    """Named actor: mailbox per (op, round). ALL methods are async so state
+    access is single-threaded on the actor loop, and waiters park on
+    asyncio.Events server-side — one RPC per rank per collective, no client
+    polling (reference keeps data on NCCL and the actor for rendezvous only;
+    here payloads are host-plane by design — see module docstring)."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
         self.rounds: dict[str, dict[int, Any]] = {}
         self.done: dict[str, Any] = {}
+        self.acks: dict[str, set] = {}
+        self._events: dict[str, "asyncio.Event"] = {}  # key -> completion event
         # Gang incarnation: an epoch is assigned only when world_size DISTINCT
         # ranks have entered the lobby (full-gang rendezvous), so all members
         # of a gang always agree on it and a restarted gang never reads
@@ -39,11 +45,12 @@ class _GroupCoordinator:
         self.epoch = 0
         self._lobby: dict[int, str] = {}  # rank -> join id
         self._assigned: dict[str, int] = {}  # join id -> epoch
+        self._join_event = asyncio.Event()
 
-    def get_world_size(self) -> int:
+    async def get_world_size(self) -> int:
         return self.world_size
 
-    def join_begin(self, rank: int, join_id: str) -> None:
+    async def join_begin(self, rank: int, join_id: str) -> None:
         self._lobby[rank] = join_id
         if len(self._lobby) == self.world_size:
             self.epoch += 1
@@ -51,40 +58,75 @@ class _GroupCoordinator:
             # observe it, its contributions must never be wiped.
             self.rounds.clear()
             self.done.clear()
+            self.acks.clear()
+            self._events.clear()
             for jid in self._lobby.values():
                 self._assigned[jid] = self.epoch
             self._lobby.clear()
+            self._join_event.set()
+            self._join_event = asyncio.Event()
 
-    def join_epoch(self, join_id: str) -> Optional[int]:
+    async def wait_epoch(self, join_id: str, timeout: float = 30.0) -> Optional[int]:
+        """Park until the full gang has joined (or timeout); returns the
+        epoch assigned to this join, or None to let the caller re-arm."""
+        if join_id in self._assigned:
+            return self._assigned[join_id]
+        ev = self._join_event
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
         return self._assigned.get(join_id)
 
-    def contribute(self, key: str, rank: int, value: Any) -> None:
-        box = self.rounds.setdefault(key, {})
-        box[rank] = value
+    def _ev(self, key: str) -> "asyncio.Event":
+        ev = self._events.get(key)
+        if ev is None:
+            ev = self._events[key] = asyncio.Event()
+        return ev
 
-    def poll(self, key: str) -> Optional[dict]:
-        box = self.rounds.get(key)
-        if box is not None and len(box) == self.world_size:
-            self.rounds.pop(key, None)
-            self.done[key] = box
-        return self.done.get(key)
-
-    def fetch(self, key: str) -> Optional[dict]:
-        return self.done.get(key)
-
-    def gc(self, key: str, rank: int) -> None:
-        ack_key = key + ":ack"
-        acks = self.rounds.setdefault(ack_key, {})
-        acks[rank] = True
-        if len(acks) == self.world_size:
-            self.rounds.pop(ack_key, None)
+    async def exchange(self, key: str, rank: int, value: Any, timeout: float = 30.0) -> Optional[dict]:
+        """Contribute and park until every rank has; returns the full box (or
+        None on timeout — callers re-arm until their own deadline). The box
+        is garbage-collected once all ranks have fetched it."""
+        if key not in self.done:
+            # Not complete yet: contribute (idempotent under re-arm) and park.
+            # The done-check guards re-arms AFTER completion from re-creating
+            # a ghost rounds[key] that would never be collected.
+            box = self.rounds.setdefault(key, {})
+            box[rank] = value
+            ev = self._ev(key)
+            if len(box) == self.world_size:
+                self.done[key] = self.rounds.pop(key)
+                ev.set()
+            else:
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout)
+                except asyncio.TimeoutError:
+                    return None
+        result = self.done.get(key)
+        if result is None:
+            return None
+        acked = self.acks.setdefault(key, set())
+        acked.add(rank)
+        if len(acked) == self.world_size:
             self.done.pop(key, None)
+            self.acks.pop(key, None)
+            self._events.pop(key, None)
+            self.rounds.pop(key, None)
+        return result
 
     # point-to-point
-    def put_p2p(self, key: str, value: Any) -> None:
+    async def put_p2p(self, key: str, value: Any) -> None:
         self.done[key] = {"v": value}
+        self._ev(key).set()
 
-    def take_p2p(self, key: str) -> Optional[dict]:
+    async def take_p2p(self, key: str, timeout: float = 30.0) -> Optional[dict]:
+        if key not in self.done:
+            try:
+                await asyncio.wait_for(self._ev(key).wait(), timeout)
+            except asyncio.TimeoutError:
+                return None
+        self._events.pop(key, None)
         return self.done.pop(key, None)
 
 
@@ -109,16 +151,21 @@ class _GroupHandle:
             return self.epoch
         deadline = time.monotonic() + timeout
         while True:
-            epoch = rt.get(self.actor.join_epoch.remote(self.join_id), timeout=timeout)
-            if epoch is not None:
-                self.epoch = epoch
-                return epoch
-            if time.monotonic() > deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise TimeoutError(
                     f"group {self.name}: gang never fully joined "
                     f"(world_size={self.world_size})"
                 )
-            time.sleep(0.005)
+            # Server-side park (event-driven); short windows so an abandoned
+            # wait never orphans an hour-long handler on the coordinator.
+            epoch = rt.get(
+                self.actor.wait_epoch.remote(self.join_id, min(remaining, 30.0)),
+                timeout=min(remaining, 30.0) + 30,
+            )
+            if epoch is not None:
+                self.epoch = epoch
+                return epoch
 
     def next_key(self, op: str) -> str:
         epoch = self.ensure_epoch()
@@ -127,20 +174,23 @@ class _GroupHandle:
         return f"e{epoch}:{op}:{c}"
 
     def exchange(self, op: str, value: Any, timeout: float = 120.0) -> dict:
-        """All ranks contribute; returns {rank: value} for all ranks."""
+        """All ranks contribute; returns {rank: value} for all ranks. One
+        round trip in the common case: the coordinator parks the call until
+        the box is complete (re-contribution on re-arm is idempotent)."""
         import ray_tpu as rt
 
         key = self.next_key(op)
-        rt.get(self.actor.contribute.remote(key, self.rank, value), timeout=timeout)
         deadline = time.monotonic() + timeout
         while True:
-            box = rt.get(self.actor.poll.remote(key), timeout=timeout)
-            if box is not None:
-                rt.get(self.actor.gc.remote(key, self.rank), timeout=timeout)
-                return box
-            if time.monotonic() > deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise TimeoutError(f"collective {op} timed out in group {self.name}")
-            time.sleep(0.005)
+            box = rt.get(
+                self.actor.exchange.remote(key, self.rank, value, min(remaining, 30.0)),
+                timeout=min(remaining, 30.0) + 30,
+            )
+            if box is not None:
+                return box
 
 
 def _groups() -> dict:
@@ -162,8 +212,10 @@ def init_collective_group(world_size: int, rank: int,
         actor = rt.get_actor(name)
     except ValueError:
         try:
+            # Waiters PARK inside async methods holding concurrency slots:
+            # budget for every rank in an exchange + p2p + epoch wait at once.
             actor = Coordinator.options(
-                name=name, lifetime="detached", max_concurrency=max(8, world_size * 2)
+                name=name, lifetime="detached", max_concurrency=max(16, world_size * 4)
             ).remote(world_size)
         except Exception:
             actor = rt.get_actor(name)
@@ -318,9 +370,12 @@ def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
     key = f"{chan}:{g.next_key(chan)}"
     deadline = time.monotonic() + timeout
     while True:
-        got = rt.get(g.actor.take_p2p.remote(key), timeout=timeout)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"recv from {src_rank} timed out")
+        got = rt.get(
+            g.actor.take_p2p.remote(key, min(remaining, 30.0)),
+            timeout=min(remaining, 30.0) + 30,
+        )
         if got is not None:
             return got["v"]
-        if time.monotonic() > deadline:
-            raise TimeoutError(f"recv from {src_rank} timed out")
-        time.sleep(0.005)

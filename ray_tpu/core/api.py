@@ -193,6 +193,11 @@ def shutdown():
     if _global_cluster is not None:
         _global_cluster.shutdown()
         _global_cluster = None
+    # The session token must not leak into a later session in this process
+    # (an authed stale key makes a fresh unauthed cluster unparseable).
+    from ray_tpu.core import rpc as _rpc
+
+    _rpc.set_auth_token(None)
 
 
 def is_initialized() -> bool:

@@ -41,6 +41,10 @@ logger = logging.getLogger(__name__)
 _REQ, _REP, _NOTIFY = 0, 1, 2
 _HDR = 8
 _TAG_LEN = 16
+# Sanity cap on a declared frame length: readexactly buffers the whole frame
+# BEFORE the auth check can reject the peer, so an untrusted header must not
+# be able to demand unbounded memory.
+_MAX_FRAME = 1 << 30
 
 _frame_key: bytes = b""  # empty = auth disabled
 
@@ -151,6 +155,9 @@ class Connection:
             while True:
                 hdr = await self.reader.readexactly(_HDR)
                 ln = int.from_bytes(hdr, "little")
+                if ln > _MAX_FRAME:
+                    logger.warning("dropping peer %s: absurd frame length %d", self.peer_name, ln)
+                    return
                 data = await self.reader.readexactly(ln)
                 if _frame_key:
                     # Constant-time per-frame HMAC check BEFORE any

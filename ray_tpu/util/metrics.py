@@ -128,7 +128,8 @@ def prometheus_text(series: list[dict]) -> str:
     for rec in series:
         name = "raytpu_" + rec["name"].replace(".", "_").replace("-", "_")
         if name not in seen_help:
-            lines.append(f"# HELP {name} {rec.get('description', '')}")
+            help_text = str(rec.get("description", "")).replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} {rec['kind']}")
             seen_help.add(name)
         labels = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(rec.get("tags", {}).items()))
