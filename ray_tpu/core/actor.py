@@ -87,6 +87,10 @@ class ActorClass:
             self._cls_session = core
         blob, _ = serialization.serialize((args, kwargs))
         opts = replace(self._opts)
+        if opts.runtime_env:
+            from ray_tpu.core.runtime_env import package_runtime_env
+
+            opts.runtime_env = package_runtime_env(core, opts.runtime_env)
         actor_id = core.create_actor_sync(
             self._cls_id, blob, opts, name=getattr(self, "_name", ""), namespace=getattr(self, "_namespace", "default")
         )

@@ -209,7 +209,11 @@ class Controller:
         import pickle
 
         state = {
-            "kv": self.kv,
+            # Runtime-env packages (multi-MB content-addressed zips) are
+            # excluded: re-pickling them every snapshot tick would stall the
+            # loop. After a controller restart, new materializations of those
+            # URIs need a re-upload (daemon-side extracted caches survive).
+            "kv": {ns: v for ns, v in self.kv.items() if ns != "runtime_env_pkg"},
             "jobs": self.jobs,
             "job_counter": self._job_counter,
             "named_actors": {k: v.binary() for k, v in self.named_actors.items()},

@@ -38,6 +38,7 @@ class WorkerRecord:
     ready: asyncio.Future | None = None
     last_idle_ts: float = 0.0
     death_reported: bool = False
+    env_hash: str = ""  # runtime-env hash this worker was built for
 
 
 class NodeDaemon:
@@ -70,7 +71,10 @@ class NodeDaemon:
         self.server = rpc.RpcServer(self, host=host)
         self.controller: rpc.Connection | None = None
         self.workers: dict[str, WorkerRecord] = {}
-        self.idle_workers: list[WorkerRecord] = []
+        # Idle pool keyed by runtime-env hash ("" = plain): a lease only
+        # reuses workers built for ITS environment (reference: worker_pool.h
+        # idle cache keyed by runtime-env hash).
+        self.idle_workers: dict[str, list[WorkerRecord]] = {}
         self._spawn_env = dict(env or {})
         self._pulls: dict[bytes, asyncio.Future] = {}
         self._bg: list[asyncio.Task] = []
@@ -170,15 +174,32 @@ class NodeDaemon:
         while True:
             await asyncio.sleep(5.0)
             now = time.monotonic()
-            for w in list(self.idle_workers):
-                if now - w.last_idle_ts > self.config.idle_worker_killing_time_s:
-                    self.idle_workers.remove(w)
-                    self._kill_worker_proc(w, "idle timeout")
+            for pool in self.idle_workers.values():
+                for w in list(pool):
+                    if now - w.last_idle_ts > self.config.idle_worker_killing_time_s:
+                        pool.remove(w)
+                        self._kill_worker_proc(w, "idle timeout")
 
     # -- worker pool ----------------------------------------------------
-    def _spawn_worker(self) -> WorkerRecord:
+    async def _materialize_env(self, renv: Optional[dict]):
+        """(env overrides, extra sys.path entries, cwd, hash) for a runtime
+        env spec; packages cached per URI under the session dir."""
+        if not renv:
+            return {}, [], None, ""
+        from ray_tpu.core import runtime_env as _re
+
+        async def kv_get(uri: str):
+            return await self.controller.call("kv_get", {"ns": _re.PKG_NS, "key": uri})
+
+        cache_root = os.path.join(self.session_dir, "runtime_envs")
+        os.makedirs(cache_root, exist_ok=True)
+        env_vars, pypath, cwd = await _re.materialize(renv, cache_root, kv_get)
+        return env_vars, pypath, cwd, renv.get("hash", "")
+
+    def _spawn_worker(self, env_overrides: dict | None = None, pypath: list | None = None,
+                      cwd: str | None = None, env_hash: str = "") -> WorkerRecord:
         worker_id = WorkerID.from_random().hex()
-        env = {**os.environ, **self._spawn_env}
+        env = {**os.environ, **self._spawn_env, **(env_overrides or {})}
         env["RAYTPU_WORKER_ID"] = worker_id
         env["RAYTPU_CONTROLLER_ADDR"] = self.controller_addr
         if self.config.auth_token:
@@ -193,15 +214,18 @@ class NodeDaemon:
         # resolve in workers — the runtime-env equivalent of the reference's
         # working_dir/py_modules propagation (_private/runtime_env/).
         driver_path = os.pathsep.join(p for p in sys.path if p)
-        parts = [repo_root, driver_path, env["PYTHONPATH"]]
+        parts = list(pypath or []) + [repo_root, driver_path, env["PYTHONPATH"]]
         env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
+            cwd=cwd,
             stdout=subprocess.DEVNULL if not os.environ.get("RAYTPU_WORKER_LOGS") else None,
             stderr=None,
         )
-        record = WorkerRecord(worker_id=worker_id, proc=proc, ready=asyncio.get_running_loop().create_future())
+        record = WorkerRecord(
+            worker_id=worker_id, proc=proc, ready=asyncio.get_running_loop().create_future(), env_hash=env_hash
+        )
         self.workers[worker_id] = record
         return record
 
@@ -224,8 +248,9 @@ class NodeDaemon:
             return
         record.state = "DEAD"
         self.workers.pop(record.worker_id, None)
-        if record in self.idle_workers:
-            self.idle_workers.remove(record)
+        pool = self.idle_workers.get(record.env_hash)
+        if pool and record in pool:
+            pool.remove(record)
         logger.warning("worker %s died (actors=%s)", record.worker_id[:8], [a.hex()[:8] for a in map(_as_actor, record.actor_ids)])
         await self._report_worker_died(record, "worker process died")
 
@@ -243,19 +268,22 @@ class NodeDaemon:
         except Exception:
             pass
 
-    async def _acquire_worker(self) -> WorkerRecord:
-        while self.idle_workers:
-            w = self.idle_workers.pop()
+    async def _acquire_worker(self, renv: Optional[dict] = None) -> WorkerRecord:
+        env_vars, pypath, cwd, env_hash = await self._materialize_env(renv)
+        pool = self.idle_workers.get(env_hash, [])
+        while pool:
+            w = pool.pop()
             if w.state == "IDLE" and w.conn and not w.conn.closed:
                 return w
-        record = self._spawn_worker()
+        record = self._spawn_worker(env_vars, pypath, cwd, env_hash)
         await asyncio.wait_for(record.ready, timeout=self.config.worker_start_timeout_s)
         return record
 
     async def handle_lease_worker(self, conn, p):
-        """Pop an idle worker (or spawn) and hand its address to the submitter
-        (reference: WorkerPool::PopWorker via HandleRequestWorkerLease)."""
-        record = await self._acquire_worker()
+        """Pop an idle worker of the right runtime env (or spawn one) and
+        hand its address to the submitter (reference: WorkerPool::PopWorker
+        via HandleRequestWorkerLease, idle cache keyed by runtime-env hash)."""
+        record = await self._acquire_worker(p.get("runtime_env"))
         record.state = "LEASED"
         return {"worker_id": record.worker_id, "address": record.address}
 
@@ -265,7 +293,7 @@ class NodeDaemon:
             if p.get("reusable", True) and record.conn and not record.conn.closed:
                 record.state = "IDLE"
                 record.last_idle_ts = time.monotonic()
-                self.idle_workers.append(record)
+                self.idle_workers.setdefault(record.env_hash, []).append(record)
             else:
                 self._kill_worker_proc(record, "not reusable")
         return True
@@ -274,7 +302,7 @@ class NodeDaemon:
         """Controller asks us to place an actor: lease a worker, have it
         construct the actor (reference: GcsActorScheduler lease+push)."""
         spec = p["spec"]
-        record = await self._acquire_worker()
+        record = await self._acquire_worker(getattr(spec.options, "runtime_env", None) or None)
         record.state = "ACTOR"
         try:
             await record.conn.call("create_actor", {"spec": spec}, timeout=self.config.actor_creation_timeout_s)
@@ -300,8 +328,9 @@ class NodeDaemon:
         already_dead = record.state == "DEAD"
         record.state = "DEAD"
         self.workers.pop(record.worker_id, None)
-        if record in self.idle_workers:
-            self.idle_workers.remove(record)
+        pool = self.idle_workers.get(record.env_hash)
+        if pool and record in pool:
+            pool.remove(record)
         if record.proc is not None and record.proc.poll() is None:
             record.proc.kill()
         # A daemon-initiated kill closes the conn AFTER state flips to DEAD,

@@ -31,7 +31,12 @@ class RemoteFunction:
         if self._fn_id is None or getattr(self, "_fn_session", None) is not core:
             self._fn_id = core.export_callable("fn", self._fn)
             self._fn_session = core
-        refs = core.submit_task_sync(self._fn_id, args, kwargs, replace(self._opts))
+        opts = replace(self._opts)
+        if opts.runtime_env:
+            from ray_tpu.core.runtime_env import package_runtime_env
+
+            opts.runtime_env = package_runtime_env(core, opts.runtime_env)
+        refs = core.submit_task_sync(self._fn_id, args, kwargs, opts)
         return refs[0] if self._opts.num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -1,0 +1,133 @@
+"""Runtime environments: per-task/actor env_vars, working_dir, py_modules.
+
+Role-equivalent to the reference's runtime_env plugin system
+(/root/reference/python/ray/_private/runtime_env/: working_dir/py_modules
+packaging with URI caching, per-node runtime-env agent materialization,
+worker-pool keying by runtime-env hash — worker_pool.h:281). Redesign:
+packages are content-addressed zips in the controller KV (the GCS KV plays
+the package store, like the reference's GCS-backed working_dir uploads);
+the node daemon materializes them into a per-URI cache directory and spawns
+workers with the env vars / cwd / sys.path the spec demands. Idle workers
+are pooled per runtime-env hash so a lease never reuses a worker built for
+a different environment.
+
+Supported keys: ``env_vars`` (dict), ``working_dir`` (local dir, shipped and
+made the worker's cwd + sys.path entry), ``py_modules`` (list of local dirs
+added to sys.path).
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import zipfile
+from typing import Any
+
+PKG_NS = "runtime_env_pkg"
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+MAX_PKG_BYTES = 256 * 1024 * 1024
+
+
+def _zip_dir(path: str) -> bytes:
+    """Deterministic zip: sorted walk order + fixed timestamps, so identical
+    directory CONTENTS always produce identical bytes (the content-addressed
+    URI and env hash must not vary with mtimes or filesystem order)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env path {path!r} is not a directory")
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                info = zipfile.ZipInfo(os.path.relpath(full, path), date_time=(1980, 1, 1, 0, 0, 0))
+                info.compress_type = zipfile.ZIP_DEFLATED
+                info.external_attr = (os.stat(full).st_mode & 0o777) << 16
+                with open(full, "rb") as src:
+                    z.writestr(info, src.read())
+    data = buf.getvalue()
+    if len(data) > MAX_PKG_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes zipped "
+            f"(max {MAX_PKG_BYTES}); ship big data via the object store instead"
+        )
+    return data
+
+
+def package_runtime_env(core, renv: dict) -> dict:
+    """Resolve a user runtime_env into a shippable spec: local dirs become
+    content-addressed packages in the controller KV (uploaded once per
+    content hash — the reference's URI cache), env_vars pass through."""
+    if renv.get("_resolved"):
+        return renv  # already packaged (e.g. reused from another task's options)
+    known = {"env_vars", "working_dir", "py_modules"}
+    unknown = set(renv) - known
+    if unknown:
+        raise ValueError(f"unsupported runtime_env keys {sorted(unknown)}; supported: {sorted(known)}")
+    cache = getattr(core, "_renv_pkg_cache", None)
+    if cache is None:
+        cache = core._renv_pkg_cache = {}
+
+    def upload(path: str) -> str:
+        path = os.path.abspath(path)
+        uri = cache.get(path)
+        if uri is not None:
+            return uri
+        data = _zip_dir(path)
+        uri = "pkg-" + hashlib.sha1(data).hexdigest()
+        core._run(
+            core.controller.call(
+                "kv_put", {"ns": PKG_NS, "key": uri, "value": data, "overwrite": False}
+            )
+        )
+        cache[path] = uri
+        return uri
+
+    spec: dict[str, Any] = {"_resolved": True, "env_vars": dict(renv.get("env_vars", {}))}
+    pkgs = []
+    if renv.get("working_dir"):
+        pkgs.append({"uri": upload(renv["working_dir"]), "kind": "working_dir"})
+    for mod in renv.get("py_modules", []):
+        pkgs.append({"uri": upload(mod), "kind": "py_module"})
+    spec["pkgs"] = pkgs
+    spec["hash"] = hashlib.sha1(
+        json.dumps({k: spec[k] for k in ("env_vars", "pkgs")}, sort_keys=True).encode()
+    ).hexdigest()[:16]
+    return spec
+
+
+async def materialize(spec: dict, cache_root: str, kv_get) -> tuple[dict, list, str | None]:
+    """Daemon-side: download/extract packages (cached per URI), return
+    (env_vars, extra sys.path entries, cwd or None). ``kv_get`` is an async
+    callable uri -> bytes."""
+    env_vars = dict(spec.get("env_vars", {}))
+    pypath: list[str] = []
+    cwd = None
+    for pkg in spec.get("pkgs", []):
+        dest = os.path.join(cache_root, pkg["uri"])
+        if not os.path.isdir(dest):
+            data = await kv_get(pkg["uri"])
+            if data is None:
+                raise RuntimeError(f"runtime_env package {pkg['uri']} missing from the cluster KV")
+
+            def extract():  # off the event loop: large zips must not stall the daemon
+                tmp = f"{dest}.tmp{os.getpid()}"
+                os.makedirs(tmp, exist_ok=True)
+                with zipfile.ZipFile(io.BytesIO(data)) as z:
+                    z.extractall(tmp)
+                try:
+                    os.rename(tmp, dest)
+                except OSError:  # concurrent materialization won the race
+                    import shutil
+
+                    shutil.rmtree(tmp, ignore_errors=True)
+
+            import asyncio
+
+            await asyncio.get_running_loop().run_in_executor(None, extract)
+        pypath.append(dest)
+        if pkg["kind"] == "working_dir":
+            cwd = dest
+    return env_vars, pypath, cwd

@@ -90,7 +90,9 @@ class ActorSpec:
 
 
 def scheduling_key(fn_id: str, opts: TaskOptions) -> str:
-    """Tasks with the same function + demand share worker leases (reference:
-    SchedulingKey in normal_task_submitter.h)."""
+    """Tasks with the same function + demand + runtime env share worker
+    leases (reference: SchedulingKey in normal_task_submitter.h; runtime-env
+    hash keying as in worker_pool.h idle caching)."""
     ss = opts.scheduling_strategy
-    return f"{fn_id}|{sorted(opts.resource_demand().items())}|{ss.kind}|{ss.node_id}|{ss.placement_group}|{ss.bundle_index}|{sorted(opts.label_selector.items())}"
+    renv = opts.runtime_env.get("hash", "") if opts.runtime_env else ""
+    return f"{fn_id}|{sorted(opts.resource_demand().items())}|{ss.kind}|{ss.node_id}|{ss.placement_group}|{ss.bundle_index}|{sorted(opts.label_selector.items())}|{renv}"

@@ -151,7 +151,10 @@ class _KeySubmitter:
                 return
             try:
                 daemon = await self.core._daemon_conn(reply["address"])
-                lease = await daemon.call("lease_worker", {"lease_id": lease_id})
+                lease = await daemon.call(
+                    "lease_worker",
+                    {"lease_id": lease_id, "runtime_env": self.opts.runtime_env or None},
+                )
                 w = LeasedWorker(lease["address"], lease["worker_id"], reply["address"], lease_id)
                 w.conn = await self.core._peer_conn(w.address)
             except Exception:

@@ -130,11 +130,18 @@ def test_gang_restart_gets_fresh_epoch(shared_ray):
     @rt.remote
     class Member(col.CollectiveActorMixin):
         def half_collective(self, rank):
-            # Join and contribute to allreduce round 0, but never complete it
-            # (simulates a gang dying mid-collective).
+            # Rank 0 contributes to allreduce round 0 but the round never
+            # completes (rank 1 stays out) — simulates a gang dying
+            # mid-collective with a 99 stranded in the epoch-1 mailbox.
             g = col.collective._group("gr")
-            g.actor.contribute.remote(f"e{g.ensure_epoch()}:allreduce:0", rank,
-                                      np.array([99.0]))
+            if rank == 0:
+                box = rt.get(
+                    g.actor.exchange.remote(
+                        f"e{g.ensure_epoch()}:allreduce:0", rank, np.array([99.0]), 0.05
+                    ),
+                    timeout=30,
+                )
+                assert box is None, "half-collective must not complete"
             return True
 
         def full_collective(self):
