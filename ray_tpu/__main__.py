@@ -124,6 +124,14 @@ def cmd_job(args):
         print(client.stop_job(args.job_id))
 
 
+def cmd_timeline(args):
+    rt = _connect(args.address)
+    from ray_tpu.util.tracing import export_timeline
+
+    n = export_timeline(args.out)
+    print(f"wrote {n} trace events to {args.out} (open in chrome://tracing or Perfetto)")
+
+
 def cmd_dashboard(args):
     rt = _connect(args.address)
     from ray_tpu.dashboard import start_dashboard
@@ -157,6 +165,8 @@ def main(argv=None):
     for name in ("status", "logs", "stop"):
         x = jsub.add_parser(name)
         x.add_argument("job_id")
+    tp = sub.add_parser("timeline")
+    tp.add_argument("--out", default="timeline.json")
     dp = sub.add_parser("dashboard")
     dp.add_argument("--port", type=int, default=8265)
     args = p.parse_args(argv)
@@ -166,6 +176,7 @@ def main(argv=None):
         "events": cmd_events,
         "metrics": cmd_metrics,
         "job": cmd_job,
+        "timeline": cmd_timeline,
         "dashboard": cmd_dashboard,
     }[args.cmd](args)
 

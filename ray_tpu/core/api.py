@@ -305,9 +305,14 @@ def _cluster_state() -> dict:
 
 
 def timeline() -> list[dict]:
+    """Cluster-wide control events + task events (aggregated across all
+    workers via the controller — see ray_tpu.util.tracing for chrome-trace
+    export of the same stream)."""
+    from ray_tpu.util.tracing import get_task_events
+
     core = _require_worker()
     events = core._run(core.controller.call("get_events", {}))
-    return events + core.task_events
+    return events + get_task_events()
 
 
 class RuntimeContext:

@@ -488,6 +488,20 @@ class Controller:
             "nodes": self._node_table(),
         }
 
+    # -- task-event aggregation (TaskEventBuffer -> GcsTaskManager equiv) -
+    def handle_report_task_events(self, conn, p):
+        if not hasattr(self, "task_events"):
+            self.task_events = []
+        self.task_events.extend(p["events"])
+        if len(self.task_events) > 4 * self.config.event_buffer_size:
+            del self.task_events[: len(self.task_events) // 2]
+        return True
+
+    def handle_get_task_events(self, conn, p):
+        limit = int(p.get("limit", 20000))
+        events = getattr(self, "task_events", [])
+        return events[-limit:] if limit > 0 else []
+
     # -- metrics aggregation (ray.util.metrics equivalent pipeline) ------
     def handle_report_metrics(self, conn, p):
         if not hasattr(self, "metrics_by_reporter"):

@@ -265,6 +265,7 @@ class CoreWorker:
         self._recovering: dict[bytes, asyncio.Future] = {}
         self._bg: list[asyncio.Task] = []
         self.task_events: list[dict] = []  # per-task event buffer (task_event_buffer.h equiv)
+        self._events_reported = 0  # high-water mark shipped to the controller
         self._current_task: Optional[TaskSpec] = None
 
     # -- lifecycle ------------------------------------------------------
@@ -280,7 +281,10 @@ class CoreWorker:
 
         self._loop_thread = threading.Thread(target=run, name="raytpu-io", daemon=True)
         self._loop_thread.start()
-        if not ready.wait(self.config.rpc_connect_timeout_s + 5):
+        # Generous margin over the dial timeout: on a loaded single-core host
+        # (CI running a full cluster per test module) registration RPCs can
+        # take several seconds of scheduler delay without anything being wrong.
+        if not ready.wait(self.config.rpc_connect_timeout_s + 30):
             raise TimeoutError("driver failed to connect to controller")
 
     async def _async_init(self, ready: threading.Event | None = None):
@@ -345,14 +349,28 @@ class CoreWorker:
                 await self._report_metrics()
 
     async def _report_metrics(self):
-        """Ship this process's metric series to the controller (reference:
-        per-node agent scrape -> dashboard; here a direct push)."""
+        """Ship this process's metric series + new task events to the
+        controller (reference: per-node agent scrape -> dashboard, and the
+        TaskEventBuffer -> GcsTaskManager pipeline, task_event_buffer.h)."""
         try:
             from ray_tpu.util import metrics as _m
 
             series = _m.snapshot()
             if series:
                 await self.controller.notify("report_metrics", {"reporter": self.worker_id, "series": series})
+        except Exception:
+            pass
+        try:
+            mark = self._events_reported
+            new = self.task_events[mark:]
+            if new:
+                await self.controller.notify(
+                    "report_task_events", {"reporter": self.worker_id, "events": new}
+                )
+                # Commit only AFTER the send: a failed report (controller
+                # down) must retry these events next tick. Recompute against
+                # the current mark — a concurrent trim may have shifted it.
+                self._events_reported = min(self._events_reported + len(new), len(self.task_events))
         except Exception:
             pass
 
@@ -419,9 +437,11 @@ class CoreWorker:
         return conn
 
     def _event(self, kind: str, **kw):
-        self.task_events.append({"ts": time.time(), "kind": kind, **kw})
+        self.task_events.append({"ts": time.time(), "kind": kind, "worker": self.worker_id[:12], **kw})
         if len(self.task_events) > self.config.event_buffer_size:
-            del self.task_events[: len(self.task_events) // 2]
+            trimmed = len(self.task_events) // 2
+            del self.task_events[:trimmed]
+            self._events_reported = max(0, self._events_reported - trimmed)
 
     # -- ownership / refcounting ---------------------------------------
     def _on_ref_created(self, ref: ObjectRef):
@@ -1097,7 +1117,7 @@ class CoreWorker:
         spec: TaskSpec = p["spec"]
         fn = await self._load_callable(spec.fn_id)
         loop = asyncio.get_running_loop()
-        self._event("task_exec_start", task_id=spec.task_id.hex())
+        self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
         try:
             result = await loop.run_in_executor(self._executor, self._execute_task, fn, spec)
             returns = await self._package_returns(spec, result)

@@ -1,0 +1,90 @@
+"""Tracing & profiling: task timeline export + TPU profiler capture.
+
+Role-equivalent to the reference's tracing stack (SURVEY §5): the C++
+TaskEventBuffer -> GcsTaskManager -> `ray timeline` pipeline
+(src/ray/core_worker/task_event_buffer.h) becomes per-worker event buffers
+shipped with the metrics reporter and aggregated on the controller; the
+py-spy/nsight on-demand profilers become the JAX profiler (XPlane/Perfetto)
+— the right tool on TPU (dashboard/modules/reporter/profile_manager.py is
+GPU/CPU-process oriented).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Optional
+
+
+def get_task_events(limit: int = 20000) -> list[dict]:
+    """Cluster-wide task events (submission, execution spans, recoveries)."""
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    # Flush this process's own buffer first so driver-side events are current.
+    core._run(core._report_metrics())
+    return core._run(core.controller.call("get_task_events", {"limit": limit}))
+
+
+def export_timeline(path: str, limit: int = 20000) -> int:
+    """Write a chrome://tracing-format timeline of task execution across the
+    cluster (the `ray timeline` equivalent). Returns the number of trace
+    events written."""
+    events = get_task_events(limit)
+    trace: list[dict] = []
+    open_spans: dict[tuple, dict] = {}  # (worker, task_id) -> start event
+    for ev in events:
+        kind = ev.get("kind", "")
+        worker = ev.get("worker", "?")
+        ts_us = ev["ts"] * 1e6
+        if kind == "task_exec_start":
+            open_spans[(worker, ev.get("task_id"))] = ev
+        elif kind == "task_exec_end":
+            start = open_spans.pop((worker, ev.get("task_id")), None)
+            if start is not None:
+                trace.append({
+                    "name": start.get("fn") or ev.get("task_id", "task")[:8],
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": start["ts"] * 1e6,
+                    "dur": max(1.0, ts_us - start["ts"] * 1e6),
+                    "pid": worker,
+                    "tid": "exec",
+                    "args": {"task_id": ev.get("task_id")},
+                })
+        elif kind in ("task_submitted", "object_recovery", "task_finished"):
+            trace.append({
+                "name": kind,
+                "cat": "control",
+                "ph": "i",
+                "s": "p",
+                "ts": ts_us,
+                "pid": worker,
+                "tid": "control",
+                "args": {k: v for k, v in ev.items() if k not in ("ts", "kind", "worker")},
+            })
+    with open(path, "w") as f:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+    return len(trace)
+
+
+@contextlib.contextmanager
+def profile_tpu(logdir: str):
+    """Capture a JAX profiler trace (XPlane; view in TensorBoard/Perfetto)
+    around a block of device work — the TPU-native analogue of the
+    reference's on-demand py-spy/nsight profiling."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_server(port: int = 9012):
+    """Start the JAX profiler server for on-demand remote capture
+    (TensorBoard 'capture profile' against this port)."""
+    import jax
+
+    return jax.profiler.start_server(port)
