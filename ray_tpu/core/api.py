@@ -151,6 +151,7 @@ def init(
     labels: dict | None = None,
     object_store_memory: int | None = None,
     config: Config | None = None,
+    log_to_driver: bool = True,
 ) -> dict:
     """Start (or connect to) a cluster and create the driver's CoreWorker."""
     global _global_worker, _global_cluster
@@ -175,9 +176,26 @@ def init(
         address = _global_cluster.address
     worker = CoreWorker(mode="driver", controller_addr=address, config=cfg)
     worker.start_driver_sync()
+    if log_to_driver:
+        _subscribe_driver_logs(worker)
     _global_worker = worker
     atexit.register(shutdown)
     return {"address": address}
+
+
+def _subscribe_driver_logs(worker: CoreWorker):
+    """Print worker stdout/stderr on the driver, prefixed by the producing
+    worker/node (reference UX: log_monitor lines surface on the driver
+    terminal with a (pid=..., ip=...) prefix)."""
+    import sys
+
+    def _print_logs(_key, data):
+        prefix = f"({data.get('worker_id', '')[:8]}, node={data.get('node_id', '')[:8]})"
+        stream = sys.stderr if data.get("stream") == "stderr" else sys.stdout
+        for line in data.get("lines", ()):
+            print(f"{prefix} {line}", file=stream, flush=True)
+
+    worker._run(worker.subscribe_channel("logs", _print_logs))
 
 
 def init_cluster(cluster: Cluster) -> dict:

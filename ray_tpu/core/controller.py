@@ -315,6 +315,12 @@ class Controller:
         self.subscribers.get(p["channel"], set()).discard(conn)
         return True
 
+    def handle_worker_logs(self, conn, p):
+        """Fan worker stdout/stderr lines out to drivers subscribed to the
+        ``logs`` channel (reference: log_monitor publishes through GCS pubsub
+        and drivers print — _private/log_monitor.py)."""
+        self.publish("logs", p.get("worker_id", ""), p)
+
     def publish(self, channel: str, key: str, data: Any):
         dead = []
         for conn in self.subscribers.get(channel, ()):  # push-based; the

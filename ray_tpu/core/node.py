@@ -79,6 +79,10 @@ class NodeDaemon:
         self._pulls: dict[bytes, asyncio.Future] = {}
         self._bg: list[asyncio.Task] = []
         self.address = ""
+        # Per-node worker log files, tailed by the LogMonitor task and
+        # forwarded to drivers (reference: _private/log_monitor.py side-car).
+        self.log_dir = os.path.join(self.session_dir, "logs", self.node_id[:12])
+        self._log_monitor = None
 
     # ------------------------------------------------------------------
     async def start(self, port: int = 0) -> str:
@@ -110,6 +114,14 @@ class NodeDaemon:
         await self.controller.ensure()
         self._bg.append(asyncio.create_task(self._heartbeat_loop()))
         self._bg.append(asyncio.create_task(self._idle_reaper_loop()))
+        from ray_tpu.log_monitor import LogMonitor
+
+        async def _publish_logs(batch: dict):
+            batch["node_id"] = self.node_id
+            await self.controller.notify("worker_logs", batch)
+
+        self._log_monitor = LogMonitor(self.log_dir, _publish_logs)
+        self._bg.append(asyncio.create_task(self._log_monitor.run()))
         logger.info("node daemon %s on %s (store %s)", self.node_id[:8], self.address, self.store_path)
         return self.address
 
@@ -216,13 +228,27 @@ class NodeDaemon:
         driver_path = os.pathsep.join(p for p in sys.path if p)
         parts = list(pypath or []) + [repo_root, driver_path, env["PYTHONPATH"]]
         env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
+        if os.environ.get("RAYTPU_WORKER_LOGS"):
+            # Debug escape hatch: inherit the daemon's terminal directly.
+            stdout, stderr = None, None
+        else:
+            # Per-worker log files, tailed by the LogMonitor and republished
+            # to drivers (reference: workers log to session files that
+            # log_monitor.py tails). Unbuffered so prints are timely.
+            os.makedirs(self.log_dir, exist_ok=True)
+            stdout = open(os.path.join(self.log_dir, f"worker-{worker_id}.out"), "ab")
+            stderr = open(os.path.join(self.log_dir, f"worker-{worker_id}.err"), "ab")
+            env.setdefault("PYTHONUNBUFFERED", "1")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
             cwd=cwd,
-            stdout=subprocess.DEVNULL if not os.environ.get("RAYTPU_WORKER_LOGS") else None,
-            stderr=None,
+            stdout=stdout,
+            stderr=stderr,
         )
+        if stdout is not None:
+            stdout.close()
+            stderr.close()
         record = WorkerRecord(
             worker_id=worker_id, proc=proc, ready=asyncio.get_running_loop().create_future(), env_hash=env_hash
         )

@@ -264,8 +264,13 @@ class CoreWorker:
         # object_recovery_manager.h:62-76).
         self._recovering: dict[bytes, asyncio.Future] = {}
         self._bg: list[asyncio.Task] = []
+        # Pubsub subscriptions: channel -> callback(key, data). Re-subscribed
+        # on every controller (re)connect (reference: subscribers re-establish
+        # long-poll streams after GCS restart).
+        self._pub_handlers: dict[str, Any] = {}
         self.task_events: list[dict] = []  # per-task event buffer (task_event_buffer.h equiv)
         self._events_reported = 0  # high-water mark shipped to the controller
+        self._events_flush_lock = asyncio.Lock()
         self._current_task: Optional[TaskSpec] = None
 
     # -- lifecycle ------------------------------------------------------
@@ -324,6 +329,8 @@ class CoreWorker:
             ready.set()
 
     async def _controller_handshake(self, conn):
+        for channel in self._pub_handlers:
+            await conn.call("subscribe", {"channel": channel})
         if self.mode != "driver":
             return  # workers register with their daemon, not the controller
         payload = {"driver_addr": self.address}
@@ -333,6 +340,17 @@ class CoreWorker:
         self.job_id = JobID(reply["job_id"])
         self.config = Config.from_dict(reply["config"])
         self._register_reply = reply
+
+    async def subscribe_channel(self, channel: str, callback):
+        """Subscribe to a controller pubsub channel; callback(key, data) runs
+        on the IO loop for every publish."""
+        self._pub_handlers[channel] = callback
+        await self.controller.call("subscribe", {"channel": channel})
+
+    def handle_pub(self, conn, p):
+        cb = self._pub_handlers.get(p.get("channel"))
+        if cb is not None:
+            cb(p.get("key"), p.get("data"))
 
     def attach_loop(self, loop: asyncio.AbstractEventLoop):
         self.loop = loop
@@ -360,19 +378,26 @@ class CoreWorker:
                 await self.controller.notify("report_metrics", {"reporter": self.worker_id, "series": series})
         except Exception:
             pass
-        try:
-            mark = self._events_reported
-            new = self.task_events[mark:]
-            if new:
-                await self.controller.notify(
-                    "report_task_events", {"reporter": self.worker_id, "events": new}
-                )
-                # Commit only AFTER the send: a failed report (controller
-                # down) must retry these events next tick. Recompute against
-                # the current mark — a concurrent trim may have shifted it.
-                self._events_reported = min(self._events_reported + len(new), len(self.task_events))
-        except Exception:
-            pass
+        await self._flush_task_events()
+
+    async def _flush_task_events(self):
+        # Serialize flushes: the periodic reporter and on-demand
+        # tracing.get_task_events() flush can interleave at the awaits,
+        # double-sending one slice and never sending the next.
+        async with self._events_flush_lock:
+            try:
+                mark = self._events_reported
+                new = self.task_events[mark:]
+                if new:
+                    await self.controller.notify(
+                        "report_task_events", {"reporter": self.worker_id, "events": new}
+                    )
+                    # Commit only AFTER the send: a failed report (controller
+                    # down) must retry these events next tick. Recompute against
+                    # the current mark — a concurrent trim may have shifted it.
+                    self._events_reported = min(self._events_reported + len(new), len(self.task_events))
+            except Exception:
+                pass
 
     def shutdown_sync(self):
         if self._shutdown or self.loop is None:

@@ -200,6 +200,12 @@ def _attention(q, k, v, cfg: TransformerConfig, positions=None, segment_ids=None
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         return ring_attention(q, k, v, axis_name="seq", causal=True)
+    if impl == "ulysses":
+        from ray_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, axis_name="seq", causal=True, segment_ids=segment_ids
+        )
     from ray_tpu.ops.attention import mha_reference
 
     return mha_reference(q, k, v, causal=True, segment_ids=segment_ids)

@@ -4,5 +4,7 @@ Each op ships a pure-jnp reference implementation (used on CPU test meshes and
 as the numerical oracle) and a Pallas TPU kernel used on real hardware.
 """
 from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.ulysses import ulysses_attention
 
-__all__ = ["flash_attention", "mha_reference"]
+__all__ = ["flash_attention", "mha_reference", "ring_attention", "ulysses_attention"]

@@ -21,8 +21,9 @@ def get_task_events(limit: int = 20000) -> list[dict]:
     from ray_tpu.core import api
 
     core = api._require_worker()
-    # Flush this process's own buffer first so driver-side events are current.
-    core._run(core._report_metrics())
+    # Flush this process's own buffer first so driver-side events are current
+    # (events only; metrics ship on their periodic schedule).
+    core._run(core._flush_task_events())
     return core._run(core.controller.call("get_task_events", {"limit": limit}))
 
 
