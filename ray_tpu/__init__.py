@@ -26,7 +26,8 @@ from ray_tpu.core.api import (
     timeline,
     wait,
 )
-from ray_tpu.core.object_ref import ObjectRef, ObjectLostError, GetTimeoutError
+from ray_tpu.core.actor import method
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator, ObjectLostError, GetTimeoutError
 from ray_tpu.core.placement_group import PlacementGroup, placement_group, remove_placement_group
 from ray_tpu.core.serialization import RemoteError
 from ray_tpu.core.task_spec import SchedulingStrategy
@@ -38,6 +39,7 @@ __all__ = [
     "GetTimeoutError",
     "ObjectLostError",
     "ObjectRef",
+    "ObjectRefGenerator",
     "PlacementGroup",
     "RemoteError",
     "SchedulingStrategy",
@@ -52,6 +54,7 @@ __all__ = [
     "is_initialized",
     "kill",
     "list_named_actors",
+    "method",
     "nodes",
     "placement_group",
     "put",

@@ -13,14 +13,48 @@ from ray_tpu.core.task_spec import ActorOptions
 from ray_tpu.core.remote_function import _apply_options
 
 
+def method(**opts):
+    """Method-level options on an actor class (reference: @ray.method —
+    python/ray/actor.py): ``@method(concurrency_group="io")`` or
+    ``@method(num_returns=2)`` on a method of a ``@remote`` class."""
+    allowed = {"concurrency_group", "num_returns"}
+    bad = set(opts) - allowed
+    if bad:
+        raise TypeError(f"unknown @method option(s): {sorted(bad)}")
+
+    def wrap(fn):
+        fn.__raytpu_method_opts__ = opts
+        return fn
+
+    return wrap
+
+
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=None,
+                 concurrency_group: str | None = None):
         self._handle = handle
         self._name = name
-        self._num_returns = num_returns
+        # None = not set here: fall back to the @method declaration, then 1/"".
+        declared = handle._method_opts.get(name, {})
+        self._num_returns = (
+            num_returns if num_returns is not None
+            else declared.get("num_returns", 1)
+        )
+        self._concurrency_group = (
+            concurrency_group if concurrency_group is not None
+            else declared.get("concurrency_group", "")
+        )
 
-    def options(self, num_returns: int = 1):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns=None, concurrency_group: str | None = None):
+        """Per-call overrides. Omitted options keep their current value
+        (@method declaration or a previous .options()); pass
+        concurrency_group="" to restore the default lane."""
+        m = ActorMethod(self._handle, self._name, num_returns, concurrency_group)
+        if num_returns is None:
+            m._num_returns = self._num_returns
+        if concurrency_group is None:
+            m._concurrency_group = self._concurrency_group
+        return m
 
     def bind(self, *args):
         """Capture this call as a compiled-DAG node (ray_tpu.dag; reference:
@@ -34,14 +68,23 @@ class ActorMethod:
 
         core = api._require_worker()
         opts = replace(self._handle._opts)
-        refs = core.submit_actor_task_sync(self._handle._actor_id, self._name, args, kwargs, self._num_returns, opts)
+        refs = core.submit_actor_task_sync(
+            self._handle._actor_id, self._name, args, kwargs, self._num_returns, opts,
+            concurrency_group=self._concurrency_group,
+        )
+        if self._num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
 
 class ActorHandle:
-    def __init__(self, actor_id: ActorID, opts: ActorOptions):
+    def __init__(self, actor_id: ActorID, opts: ActorOptions, method_opts: dict | None = None):
         self._actor_id = actor_id
         self._opts = opts
+        # {method_name: {@method options}} captured from the class at
+        # .remote() time, so handles (including deserialized ones) honor
+        # @method(num_returns=..., concurrency_group=...) declarations.
+        self._method_opts = method_opts or {}
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -52,7 +95,7 @@ class ActorHandle:
         return f"ActorHandle({self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._opts))
+        return (ActorHandle, (self._actor_id, self._opts, self._method_opts))
 
     def __hash__(self):
         return hash(self._actor_id)
@@ -94,7 +137,12 @@ class ActorClass:
         actor_id = core.create_actor_sync(
             self._cls_id, blob, opts, name=getattr(self, "_name", ""), namespace=getattr(self, "_namespace", "default")
         )
-        return ActorHandle(actor_id, opts)
+        method_opts = {
+            n: dict(getattr(m, "__raytpu_method_opts__"))
+            for n, m in vars(self._cls).items()
+            if callable(m) and hasattr(m, "__raytpu_method_opts__")
+        }
+        return ActorHandle(actor_id, opts, method_opts)
 
     def __call__(self, *a, **k):
         raise TypeError(f"actor class {self.__name__} cannot be instantiated directly; use .remote()")

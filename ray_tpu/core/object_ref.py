@@ -77,6 +77,101 @@ def _reconstruct_ref(oid: ObjectID, owner_addr: str, size_hint: int) -> ObjectRe
     return ObjectRef(oid, owner_addr, size_hint)
 
 
+class ObjectRefGenerator:
+    """Iterator over the streamed returns of a generator task.
+
+    Reference: streaming generators — the executor reports each yielded item
+    as its own return object (core_worker.proto ReportGeneratorItemReturns;
+    TaskManager streaming-generator returns) and the caller iterates
+    ObjectRefs as they arrive, before the task finishes. Items are pushed
+    from the IO loop (``_push``); ``__next__`` blocks the consuming thread
+    until the next indexed item or end-of-stream. A worker-crash retry
+    replays the stream from index 0; ``reserve`` dedups already-seen indices
+    so consumers observe each index exactly once.
+    """
+
+    def __init__(self, task_id, owner_addr: str):
+        import threading
+
+        self.task_id = task_id
+        self.owner_addr = owner_addr
+        self._cond = threading.Condition()
+        self._items: dict[int, ObjectRef] = {}  # arrived, unconsumed
+        self._seen: set[int] = set()
+        self._next = 0
+        self._total: Optional[int] = None
+        self._error: Optional[BaseException] = None
+        # Consumption-ack hook for backpressured streams (set by the core
+        # worker when the producer requests acks).
+        self._ack = None
+
+    # -- producer side (IO loop) --------------------------------------
+    def reserve(self, index: int) -> bool:
+        """True if this index is new (caller should register + push)."""
+        with self._cond:
+            if index in self._seen:
+                return False
+            self._seen.add(index)
+            return True
+
+    def _push(self, index: int, ref: ObjectRef):
+        with self._cond:
+            self._items[index] = ref
+            self._cond.notify_all()
+
+    def _finish(self, total: Optional[int] = None, error: BaseException | None = None):
+        with self._cond:
+            if total is not None:
+                self._total = total
+            if error is not None:
+                self._error = error
+                if self._total is None:
+                    # Hand out what already arrived, then raise.
+                    self._total = max(self._items, default=-1) + 1
+            self._cond.notify_all()
+
+    # -- consumer side -------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._next_item(None)
+
+    def next_with_timeout(self, timeout: float) -> ObjectRef:
+        return self._next_item(timeout)
+
+    def _next_item(self, timeout) -> ObjectRef:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._next in self._items:
+                    ref = self._items.pop(self._next)
+                    self._next += 1
+                    ack, consumed = self._ack, self._next
+                    if ack is not None:
+                        ack(consumed)
+                    return ref
+                if self._total is not None and self._next >= self._total:
+                    if self._error is not None:
+                        raise self._error
+                    raise StopIteration
+                remaining = None if deadline is None else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError("generator item timeout")
+                self._cond.wait(remaining if remaining is not None else 1.0)
+
+    def completed(self) -> bool:
+        with self._cond:
+            return self._total is not None
+
+    def __del__(self):
+        # Unconsumed item refs drop their pins through ObjectRef.__del__.
+        with self._cond:
+            self._items.clear()
+
+
 class ObjectLostError(Exception):
     pass
 

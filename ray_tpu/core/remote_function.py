@@ -37,6 +37,8 @@ class RemoteFunction:
 
             opts.runtime_env = package_runtime_env(core, opts.runtime_env)
         refs = core.submit_task_sync(self._fn_id, args, kwargs, opts)
+        if self._opts.num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if self._opts.num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -38,6 +38,10 @@ class TaskOptions:
     label_selector: dict = field(default_factory=dict)
     name: str = ""
     runtime_env: dict = field(default_factory=dict)
+    # Streaming generators: max yielded-but-unconsumed items before the
+    # producer pauses for consumer acks; -1 = unbounded (reference:
+    # _generator_backpressure_num_objects, same default).
+    generator_backpressure: int = -1
 
     def resource_demand(self) -> dict:
         d = dict(self.resources)
@@ -57,6 +61,11 @@ class ActorOptions(TaskOptions):
     lifetime: str = ""  # "" | "detached"
     get_if_exists: bool = False
     max_pending_calls: int = -1
+    # Named concurrency groups: {"io": 2, "compute": 4} gives each group its
+    # own executor lane with its own parallelism cap (reference:
+    # ConcurrencyGroupManager, core_worker/task_execution). Methods pick a
+    # group via @method(concurrency_group=...) or per-call .options().
+    concurrency_groups: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -71,6 +80,7 @@ class TaskSpec:
     # actor-task fields
     actor_id: Optional[ActorID] = None
     method_name: str = ""
+    concurrency_group: str = ""  # "" = method default, then the default lane
 
     @property
     def is_actor_task(self) -> bool:

@@ -39,7 +39,13 @@ from typing import Any, Optional
 from ray_tpu.core import rpc, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
-from ray_tpu.core.object_ref import GetTimeoutError, ObjectLostError, ObjectRef, set_ref_hooks
+from ray_tpu.core.object_ref import (
+    GetTimeoutError,
+    ObjectLostError,
+    ObjectRef,
+    ObjectRefGenerator,
+    set_ref_hooks,
+)
 from ray_tpu.core.object_store import MemoryStore, ObjectStoreFullError, SharedMemoryClient
 from ray_tpu.core.serialization import RemoteError
 from ray_tpu.core.task_spec import ActorSpec, TaskOptions, TaskSpec, scheduling_key
@@ -268,6 +274,12 @@ class CoreWorker:
         # on every controller (re)connect (reference: subscribers re-establish
         # long-poll streams after GCS restart).
         self._pub_handlers: dict[str, Any] = {}
+        # Live streaming-generator tasks this process submitted:
+        # task_id bytes -> ObjectRefGenerator (reference: TaskManager's
+        # streaming-generator return bookkeeping).
+        self._streaming: dict[bytes, "ObjectRefGenerator"] = {}
+        # Executor side: consumer-ack state per backpressured stream.
+        self._gen_ack_state: dict[bytes, dict] = {}
         self.task_events: list[dict] = []  # per-task event buffer (task_event_buffer.h equiv)
         self._events_reported = 0  # high-water mark shipped to the controller
         self._events_flush_lock = asyncio.Lock()
@@ -562,6 +574,11 @@ class CoreWorker:
 
     def _fail_task_returns(self, spec: TaskSpec, err: BaseException):
         self._inflight_deps.pop(spec.task_id.binary(), None)
+        if spec.num_returns == -1:
+            gen = self._streaming.pop(spec.task_id.binary(), None)
+            if gen is not None:
+                gen._finish(error=err)
+            return
         for i in range(spec.num_returns):
             self._mark_ready(ObjectID.for_return(spec.task_id, i), size=0, in_memory=False, in_shm=False, error=err)
 
@@ -1028,31 +1045,39 @@ class CoreWorker:
         return obj
 
     # -- task submission ------------------------------------------------
-    def submit_task_sync(self, fn_id: str, args: tuple, kwargs: dict, opts: TaskOptions) -> list[ObjectRef]:
+    def submit_task_sync(self, fn_id: str, args: tuple, kwargs: dict, opts: TaskOptions):
         task_id = TaskID.from_random()
-        return_refs = [ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(opts.num_returns)]
+        streaming = opts.num_returns == "streaming"
+        n_returns = -1 if streaming else opts.num_returns
+        return_refs = [] if streaming else [
+            ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(n_returns)
+        ]
         args_blob, dep_refs = serialization.serialize((args, kwargs))
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
             fn_id=fn_id,
             args_blob=args_blob,
-            num_returns=opts.num_returns,
+            num_returns=n_returns,
             options=opts,
             caller_addr=self.address,
         )
+        gen = ObjectRefGenerator(task_id, self.address) if streaming else None
+
         # One loop hop, no blocking: registration + submission run as a single
         # FIFO callback, so they land before any subsequent get/free from this
         # thread. Ownership records exist before the task can complete, else a
         # fast reply could free the returns before the refs pin them.
         def _go():
+            if gen is not None:
+                self._streaming[task_id.binary()] = gen
             self._register_returns(return_refs)
             asyncio.ensure_future(self._submit(spec, dep_refs))
 
         self.loop.call_soon_threadsafe(_go)
         for r in return_refs:
             r._registered = True
-        return return_refs
+        return gen if streaming else return_refs
 
     def _register_returns(self, refs):
         for r in refs:
@@ -1105,6 +1130,16 @@ class CoreWorker:
         """Record task return values from a push_task reply."""
         deps = self._inflight_deps.pop(spec.task_id.binary(), None)
         self._event("task_finished", task_id=spec.task_id.hex(), status=reply.get("status"))
+        if spec.num_returns == -1:  # streaming: items arrived via notifies
+            gen = self._streaming.pop(spec.task_id.binary(), None)
+            if gen is not None:
+                if reply.get("status") == "error":
+                    gen._finish(error=reply.get("error") or RemoteError("task failed"))
+                else:
+                    gen._finish(total=reply.get("streaming_done", 0))
+            if fut is not None and not fut.done():
+                fut.set_result(reply.get("status") != "error")
+            return
         if reply.get("status") == "error":
             err: BaseException = reply.get("error") or RemoteError("task failed")
             for i in range(spec.num_returns):
@@ -1120,14 +1155,38 @@ class CoreWorker:
         if any(item.get("inline") is None for item in returns) and spec.actor_id is None:
             self._add_lineage(spec, deps or [])
         for i, item in enumerate(returns):
-            oid = ObjectID.for_return(spec.task_id, i)
-            if item.get("inline") is not None:
-                self.memory_store.put(oid, item["inline"])
-                self._mark_ready(oid, size=len(item["inline"]), in_memory=True, in_shm=False)
-            else:
-                self._mark_ready(oid, size=item.get("size", 0), in_memory=False, in_shm=True)
+            self._absorb_return_item(ObjectID.for_return(spec.task_id, i), item)
         if fut is not None and not fut.done():
             fut.set_result(True)
+
+    def handle_generator_item(self, conn, p):
+        """Caller side: one streamed item from an executing generator task
+        (reference: CoreWorkerService.ReportGeneratorItemReturns). Registers
+        the item object under this owner and hands its ref to the consumer."""
+        gen = self._streaming.get(p["task_id"])
+        index = p["index"]
+        if gen is None or not gen.reserve(index):
+            return  # stale task or duplicate index from a retry replay
+        oid = ObjectID.for_return(TaskID(p["task_id"]), index)
+        rec = self._register_owned(oid)
+        rec.local_refs += 1
+        self._absorb_return_item(oid, p["item"])
+        if p.get("want_ack") and gen._ack is None:
+            loop = self.loop
+
+            def ack(consumed: int, conn=conn, tb=p["task_id"]):
+                def go():
+                    if not conn.closed:
+                        asyncio.ensure_future(
+                            conn.notify("generator_ack", {"task_id": tb, "consumed": consumed})
+                        )
+
+                loop.call_soon_threadsafe(go)
+
+            gen._ack = ack
+        ref = ObjectRef(oid, self.address, _register=False)
+        ref._registered = True
+        gen._push(index, ref)
 
     # -- task execution (executor side) --------------------------------
     async def handle_push_tasks(self, conn, p):
@@ -1144,6 +1203,9 @@ class CoreWorker:
         loop = asyncio.get_running_loop()
         self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
         try:
+            if spec.num_returns == -1:
+                n = await self._execute_streaming_task(conn, fn, spec, loop)
+                return {"status": "ok", "streaming_done": n}
             result = await loop.run_in_executor(self._executor, self._execute_task, fn, spec)
             returns = await self._package_returns(spec, result)
             return {"status": "ok", "returns": returns}
@@ -1151,6 +1213,63 @@ class CoreWorker:
             return {"status": "error", "error": serialization.RemoteError.from_exception(e, where=f"task {spec.fn_id[:24]}")}
         finally:
             self._event("task_exec_end", task_id=spec.task_id.hex())
+
+    async def _execute_streaming_task(self, conn, fn, spec: TaskSpec, loop) -> int:
+        """Run a generator task, shipping each yielded item to the caller as
+        its own return object the moment it is produced (reference: streaming
+        generators — ReportGeneratorItemReturns per item, then the final
+        reply). The producing thread blocks until each item frame is on the
+        transport (TCP backpressure only); bounding by CONSUMPTION is opt-in
+        via TaskOptions.generator_backpressure, which pauses the producer
+        until the consumer acks (reference:
+        _generator_backpressure_num_objects, default unbounded)."""
+
+        def run():
+            out = self._execute_task(fn, spec)
+            if not inspect.isgenerator(out):
+                raise TypeError(
+                    f"task {spec.fn_id[:24]} declared num_returns='streaming' "
+                    f"but returned {type(out).__name__}, not a generator"
+                )
+            count = 0
+            for value in out:
+                asyncio.run_coroutine_threadsafe(
+                    self._ship_generator_item(conn, spec, count, value), loop
+                ).result()
+                count += 1
+            return count
+
+        try:
+            return await loop.run_in_executor(self._executor, run)
+        finally:
+            self._gen_ack_state.pop(spec.task_id.binary(), None)
+
+    async def _ship_generator_item(self, conn, spec: TaskSpec, index: int, value):
+        bp = getattr(spec.options, "generator_backpressure", -1)
+        if bp and bp > 0:
+            st = self._gen_ack_state.setdefault(
+                spec.task_id.binary(), {"consumed": 0, "event": asyncio.Event()}
+            )
+            while index - st["consumed"] >= bp:
+                st["event"].clear()
+                await st["event"].wait()
+        item = await self._package_value(ObjectID.for_return(spec.task_id, index), value)
+        await conn.notify(
+            "generator_item",
+            {
+                "task_id": spec.task_id.binary(),
+                "index": index,
+                "item": item,
+                "want_ack": bool(bp and bp > 0),
+            },
+        )
+
+    def handle_generator_ack(self, conn, p):
+        """Executor side: consumer progress for a backpressured stream."""
+        st = self._gen_ack_state.get(p["task_id"])
+        if st is not None and p["consumed"] > st["consumed"]:
+            st["consumed"] = p["consumed"]
+            st["event"].set()
 
     def _execute_task(self, fn, spec: TaskSpec):
         args, kwargs = serialization.deserialize(spec.args_blob)
@@ -1162,20 +1281,34 @@ class CoreWorker:
         finally:
             self._current_task = None
 
+    async def _package_value(self, oid: ObjectID, value) -> dict:
+        """Serialize one return/stream item: small -> inline bytes in the
+        reply frame; large -> local shm under ``oid`` (size in the frame).
+        Single source of the inline-vs-shm split for both the plain-return
+        and streaming paths."""
+        data, _ = serialization.serialize(value)
+        if len(data) <= self.config.max_inline_object_size or self.store is None:
+            return {"inline": data}
+        await self._write_shm(oid, data)
+        return {"size": len(data)}
+
+    def _absorb_return_item(self, oid: ObjectID, item: dict):
+        """Caller-side mirror of _package_value: register one arrived
+        return/stream item under this owner."""
+        if item.get("inline") is not None:
+            self.memory_store.put(oid, item["inline"])
+            self._mark_ready(oid, size=len(item["inline"]), in_memory=True, in_shm=False)
+        else:
+            self._mark_ready(oid, size=item.get("size", 0), in_memory=False, in_shm=True)
+
     async def _package_returns(self, spec: TaskSpec, result) -> list[dict]:
         values = (result,) if spec.num_returns == 1 else tuple(result) if spec.num_returns > 1 else ()
         if spec.num_returns > 1 and len(values) != spec.num_returns:
             raise ValueError(f"task declared num_returns={spec.num_returns} but returned {len(values)}")
-        out = []
-        for i, v in enumerate(values):
-            data, _ = serialization.serialize(v)
-            if len(data) <= self.config.max_inline_object_size or self.store is None:
-                out.append({"inline": data})
-            else:
-                oid = ObjectID.for_return(spec.task_id, i)
-                await self._write_shm(oid, data)
-                out.append({"size": len(data)})
-        return out
+        return [
+            await self._package_value(ObjectID.for_return(spec.task_id, i), v)
+            for i, v in enumerate(values)
+        ]
 
     # -- actors: caller side -------------------------------------------
     def create_actor_sync(self, cls_id: str, init_args_blob: bytes, opts, name: str = "", namespace: str = "default") -> ActorID:
@@ -1199,30 +1332,39 @@ class CoreWorker:
         self._actor_conns[actor_id] = {"addr": info["worker_addr"], "conn": None}
         return actor_id
 
-    def submit_actor_task_sync(self, actor_id: ActorID, method: str, args, kwargs, num_returns: int, opts) -> list[ObjectRef]:
+    def submit_actor_task_sync(self, actor_id: ActorID, method: str, args, kwargs, num_returns, opts,
+                               concurrency_group: str = ""):
         task_id = TaskID.from_random()
+        streaming = num_returns == "streaming"
+        n_returns = -1 if streaming else num_returns
         args_blob, dep_refs = serialization.serialize((args, kwargs))
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
             fn_id="",
             args_blob=args_blob,
-            num_returns=num_returns,
+            num_returns=n_returns,
             options=opts,
             caller_addr=self.address,
             actor_id=actor_id,
             method_name=method,
+            concurrency_group=concurrency_group,
         )
-        refs = [ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(num_returns)]
+        refs = [] if streaming else [
+            ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(n_returns)
+        ]
+        gen = ObjectRefGenerator(task_id, self.address) if streaming else None
 
         def _go():
+            if gen is not None:
+                self._streaming[task_id.binary()] = gen
             self._register_returns(refs)
             asyncio.ensure_future(self._submit_actor_task(spec, dep_refs))
 
         self.loop.call_soon_threadsafe(_go)
         for r in refs:
             r._registered = True
-        return refs
+        return gen if streaming else refs
 
     async def _submit_actor_task(self, spec: TaskSpec, dep_refs):
         # Per-actor FIFO pump: submission order must equal wire order (actor
@@ -1362,7 +1504,7 @@ class CoreWorker:
     async def handle_push_actor_task(self, conn, p):
         if self._actor_runtime is None:
             raise rpc.RpcError("no actor hosted on this worker")
-        return await self._actor_runtime.execute(p["spec"])
+        return await self._actor_runtime.execute(p["spec"], conn)
 
     # -- compiled DAG stages (ray_tpu.dag; channels ride the existing peer
     # connections — reference: compiled_dag_node.py exec loops + channels) --
@@ -1404,7 +1546,11 @@ class CoreWorker:
 
 class ActorRuntime:
     """Hosts one actor instance: FIFO ordering, max_concurrency via thread
-    pool (sync methods) or asyncio semaphore (async methods)."""
+    pool (sync methods) or asyncio semaphore (async methods). Named
+    concurrency groups get their own lane (pool + semaphore) so e.g. an "io"
+    group keeps serving health checks while the default lane is saturated
+    (reference: ConcurrencyGroupManager + per-group fiber/thread executors,
+    core_worker/task_execution)."""
 
     def __init__(self, core: CoreWorker, spec: ActorSpec, cls):
         self.core = core
@@ -1416,6 +1562,30 @@ class ActorRuntime:
         self.sem = asyncio.Semaphore(maxc)
         self._ordered = maxc == 1
         self._chain: asyncio.Future | None = None
+        self._group_pools: dict[str, concurrent.futures.ThreadPoolExecutor] = {}
+        self._group_sems: dict[str, asyncio.Semaphore] = {}
+        for gname, gmax in (spec.options.concurrency_groups or {}).items():
+            gmax = max(1, int(gmax))
+            self._group_pools[gname] = concurrent.futures.ThreadPoolExecutor(
+                max_workers=gmax, thread_name_prefix=f"actor-{gname}"
+            )
+            self._group_sems[gname] = asyncio.Semaphore(gmax)
+
+    def _lane(self, spec: TaskSpec, method) -> tuple:
+        """(pool, semaphore, ordered) for this call: explicit per-call group,
+        else the method's @method default, else the default lane."""
+        group = spec.concurrency_group or getattr(
+            method, "__raytpu_method_opts__", {}
+        ).get("concurrency_group", "")
+        if group:
+            pool = self._group_pools.get(group)
+            if pool is None:
+                raise ValueError(
+                    f"unknown concurrency group {group!r}: declared groups are "
+                    f"{sorted(self._group_pools)}"
+                )
+            return pool, self._group_sems[group], False
+        return self.pool, self.sem, self._ordered
 
     async def construct(self, args, kwargs):
         loop = asyncio.get_running_loop()
@@ -1427,7 +1597,7 @@ class ActorRuntime:
 
         self.instance = await loop.run_in_executor(self.pool, make)
 
-    async def execute(self, spec: TaskSpec) -> dict:
+    async def execute(self, spec: TaskSpec, conn=None) -> dict:
         method = getattr(self.instance, spec.method_name, None)
         if method is None:
             return {
@@ -1435,21 +1605,52 @@ class ActorRuntime:
                 "error": RemoteError.from_exception(AttributeError(f"no method {spec.method_name}"), "actor task"),
             }
         try:
+            if spec.num_returns == -1:  # streaming generator method
+                n = await self._execute_streaming(method, spec, conn)
+                return {"status": "ok", "streaming_done": n}
+            pool, sem, _ordered = self._lane(spec, method)
             if inspect.iscoroutinefunction(method):
-                async with self.sem:
+                async with sem:
                     result = await self._call_async(method, spec)
             else:
                 loop = asyncio.get_running_loop()
-                coro = loop.run_in_executor(self.pool, self._call_sync, method, spec)
-                if self._ordered:
-                    # Single-threaded pool already serializes; just await.
-                    result = await coro
-                else:
-                    result = await coro
+                result = await loop.run_in_executor(pool, self._call_sync, method, spec)
             returns = await self.core._package_returns(spec, result)
             return {"status": "ok", "returns": returns}
         except BaseException as e:  # noqa: BLE001
             return {"status": "error", "error": RemoteError.from_exception(e, where=f"actor method {spec.method_name}")}
+
+    async def _execute_streaming(self, method, spec: TaskSpec, conn) -> int:
+        """Stream a generator actor method's yields to the caller (same wire
+        protocol as streaming normal tasks: one generator_item notify per
+        yield, count in the final reply)."""
+        loop = asyncio.get_running_loop()
+        pool, sem, _ = self._lane(spec, method)
+        if inspect.isasyncgenfunction(method):
+            args, kwargs = await loop.run_in_executor(None, self._resolve, spec.args_blob)
+            count = 0
+            async with sem:
+                async for value in method(*args, **kwargs):
+                    await self.core._ship_generator_item(conn, spec, count, value)
+                    count += 1
+            return count
+
+        def run():
+            out = self._call_sync(method, spec)
+            if not inspect.isgenerator(out):
+                raise TypeError(
+                    f"actor method {spec.method_name} declared "
+                    f"num_returns='streaming' but returned {type(out).__name__}"
+                )
+            n = 0
+            for value in out:
+                asyncio.run_coroutine_threadsafe(
+                    self.core._ship_generator_item(conn, spec, n, value), loop
+                ).result()
+                n += 1
+            return n
+
+        return await loop.run_in_executor(pool, run)
 
     def _resolve(self, blob):
         args, kwargs = serialization.deserialize(blob)
