@@ -137,11 +137,11 @@ class ActorClass:
         actor_id = core.create_actor_sync(
             self._cls_id, blob, opts, name=getattr(self, "_name", ""), namespace=getattr(self, "_namespace", "default")
         )
-        method_opts = {
-            n: dict(getattr(m, "__raytpu_method_opts__"))
-            for n, m in vars(self._cls).items()
-            if callable(m) and hasattr(m, "__raytpu_method_opts__")
-        }
+        method_opts: dict = {}
+        for klass in reversed(self._cls.__mro__):  # walk bases: subclasses win
+            for n, m in vars(klass).items():
+                if callable(m) and hasattr(m, "__raytpu_method_opts__"):
+                    method_opts[n] = dict(m.__raytpu_method_opts__)
         return ActorHandle(actor_id, opts, method_opts)
 
     def __call__(self, *a, **k):

@@ -51,6 +51,11 @@ class Config:
     worker_register_timeout_s: float = 30.0
     worker_start_timeout_s: float = 60.0
     idle_worker_killing_time_s: float = 300.0
+    # OOM worker killing (reference: raylet memory monitor +
+    # worker_killing_policy, default threshold 0.95 at 250ms cadence;
+    # <= 0 disables the monitor).
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 0.25
     # --- scheduling ---
     scheduler_spread_threshold: float = 0.5
     max_pending_lease_requests_per_key: int = 10

@@ -37,6 +37,8 @@ class WorkerRecord:
     actor_ids: list = field(default_factory=list)
     ready: asyncio.Future | None = None
     last_idle_ts: float = 0.0
+    state_ts: float = 0.0  # last state transition (OOM victim ordering)
+    restartable_actor: bool = False  # hosted actor has max_restarts != 0
     death_reported: bool = False
     env_hash: str = ""  # runtime-env hash this worker was built for
 
@@ -122,6 +124,16 @@ class NodeDaemon:
 
         self._log_monitor = LogMonitor(self.log_dir, _publish_logs)
         self._bg.append(asyncio.create_task(self._log_monitor.run()))
+        from ray_tpu.core.memory_monitor import MemoryMonitor
+
+        self._memory_monitor = MemoryMonitor(
+            threshold=self.config.memory_usage_threshold,
+            interval_s=self.config.memory_monitor_interval_s,
+            get_workers=lambda: list(self.workers.values()),
+            kill=self._kill_worker_proc,
+            restartable=lambda w: w.restartable_actor,
+        )
+        self._bg.append(asyncio.create_task(self._memory_monitor.run()))
         logger.info("node daemon %s on %s (store %s)", self.node_id[:8], self.address, self.store_path)
         return self.address
 
@@ -263,6 +275,7 @@ class NodeDaemon:
         record.conn = conn
         record.address = p["address"]
         record.state = "IDLE"
+        record.state_ts = time.monotonic()
         conn.meta.update(role="worker", worker_id=p["worker_id"])
         conn.on_close = lambda c, r=record: asyncio.get_event_loop().create_task(self._on_worker_conn_closed(r))
         if record.ready and not record.ready.done():
@@ -311,6 +324,7 @@ class NodeDaemon:
         via HandleRequestWorkerLease, idle cache keyed by runtime-env hash)."""
         record = await self._acquire_worker(p.get("runtime_env"))
         record.state = "LEASED"
+        record.state_ts = time.monotonic()
         return {"worker_id": record.worker_id, "address": record.address}
 
     def handle_return_worker(self, conn, p):
@@ -318,7 +332,7 @@ class NodeDaemon:
         if record and record.state == "LEASED":
             if p.get("reusable", True) and record.conn and not record.conn.closed:
                 record.state = "IDLE"
-                record.last_idle_ts = time.monotonic()
+                record.last_idle_ts = record.state_ts = time.monotonic()
                 self.idle_workers.setdefault(record.env_hash, []).append(record)
             else:
                 self._kill_worker_proc(record, "not reusable")
@@ -330,12 +344,14 @@ class NodeDaemon:
         spec = p["spec"]
         record = await self._acquire_worker(getattr(spec.options, "runtime_env", None) or None)
         record.state = "ACTOR"
+        record.state_ts = time.monotonic()
         try:
             await record.conn.call("create_actor", {"spec": spec}, timeout=self.config.actor_creation_timeout_s)
         except Exception:
             self._kill_worker_proc(record, "actor creation failed")
             raise
         record.actor_ids.append(spec.actor_id.binary())
+        record.restartable_actor = getattr(spec.options, "max_restarts", 0) != 0
         return {"worker_addr": record.address, "worker_id": record.worker_id}
 
     async def handle_kill_worker(self, conn, p):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import io
 import pickle
+import sys
 import traceback
 from typing import Any, Callable
 
@@ -34,6 +35,14 @@ def get_serialization_context() -> SerializationContext:
     return _context
 
 
+def _restore_device_array(host):
+    """Re-materialize a device array on this process's default device (H2D
+    put on a TPU worker; no copy on the CPU backend)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(host)
+
+
 class _RefAwarePickler(cloudpickle.CloudPickler):
     def __init__(self, file, protocol=_PROTOCOL, buffer_callback=None):
         super().__init__(file, protocol=protocol, buffer_callback=buffer_callback)
@@ -51,6 +60,28 @@ class _RefAwarePickler(cloudpickle.CloudPickler):
             if _context.on_ref_serialized is not None:
                 _context.on_ref_serialized(obj)
             return obj.__reduce__()
+        # Device-tensor transport (reference: gpu_object_manager — tensors
+        # bypass the generic pickle path). jax.Array's own reduce embeds the
+        # payload INSIDE the pickle stream (an extra copy each way); here a
+        # single-device array becomes one D2H transfer whose host buffer
+        # rides the protocol-5 out-of-band path — scatter-written straight
+        # into shared memory with no intermediate join, and restored with
+        # one device_put on the consuming worker. Multi-device (sharded)
+        # arrays keep the default path: their transport is XLA's job
+        # (in-program collectives / jax transfer), not the object store's.
+        if "jax" in sys.modules and type(obj).__module__.startswith(("jaxlib", "jax")):
+            import jax
+
+            if isinstance(obj, jax.Array):
+                try:
+                    single = obj.is_fully_addressable and len(obj.sharding.device_set) == 1
+                except Exception:
+                    single = False
+                if single:
+                    import numpy as np
+
+                    host = np.asarray(jax.device_get(obj))
+                    return (_restore_device_array, (host,))
         # Delegate to CloudPickler's override — that's where by-value
         # pickling of local functions/classes lives; returning
         # NotImplemented here would silently drop it.

@@ -108,7 +108,7 @@ class _KeySubmitter:
             free_workers = [w for w in self.workers if not w.busy and not (w.conn and w.conn.closed)]
             if not free_workers:
                 break
-            per = max(1, min(16, (len(self.queue) + len(free_workers) - 1) // len(free_workers)))
+            per = max(1, min(64, (len(self.queue) + len(free_workers) - 1) // len(free_workers)))
             for w in free_workers:
                 if not self.queue:
                     break
@@ -1171,7 +1171,11 @@ class CoreWorker:
         rec = self._register_owned(oid)
         rec.local_refs += 1
         self._absorb_return_item(oid, p["item"])
-        if p.get("want_ack") and gen._ack is None:
+        if p.get("want_ack"):
+            # (Re)install on every item: after a connection-loss retry the
+            # stream arrives on a NEW conn — acks pinned to the dead one
+            # would never reach the fresh executor attempt and a
+            # backpressured producer would stall forever.
             loop = self.loop
 
             def ack(consumed: int, conn=conn, tb=p["task_id"]):
@@ -1378,14 +1382,33 @@ class CoreWorker:
 
     async def _actor_send_pump(self, actor_id: ActorID, q: "asyncio.Queue"):
         while True:
-            spec, dep_refs = await q.get()
+            batch = [await q.get()]
+            # Batch-drain: everything already queued ships back-to-back with
+            # one transport flush at the end (amortizes the drain under async
+            # call storms; pump order still == wire order, and every call
+            # keeps its own reply future).
+            while len(batch) < 64 and not q.empty():
+                batch.append(q.get_nowait())
             try:
-                if dep_refs:
-                    self._inflight_deps[spec.task_id.binary()] = dep_refs
-                    await self._wait_deps(dep_refs)
-                await self._push_actor_task_ordered(spec)
+                specs = []
+                for spec, dep_refs in batch:
+                    if dep_refs:
+                        # Ship everything accumulated BEFORE awaiting this
+                        # task's deps: a dep may be produced by an earlier
+                        # batchmate (a.m2.remote(a.m1.remote()) lands both in
+                        # one drain) — holding m1 unsent while waiting on its
+                        # result would deadlock the pump.
+                        if specs:
+                            await self._push_actor_batch_ordered(specs)
+                            specs = []
+                        self._inflight_deps[spec.task_id.binary()] = dep_refs
+                        await self._wait_deps(dep_refs)
+                    specs.append(spec)
+                if specs:
+                    await self._push_actor_batch_ordered(specs)
             except ActorDiedError as e:
-                self._fail_task_returns(spec, e)
+                for spec, _ in batch:
+                    self._fail_task_returns(spec, e)
                 # Actor is gone: fail everything still queued and retire the
                 # pump (a later submission spawns a fresh one, which handles
                 # the restarted-actor case via address refresh).
@@ -1396,43 +1419,58 @@ class CoreWorker:
                     del self._actor_send_queues[actor_id]
                 return
             except Exception as e:  # keep the pump alive for later tasks
-                self._fail_task_returns(
-                    spec,
-                    ActorDiedError(
-                        f"actor {actor_id.hex()[:8]} task {spec.method_name} failed to submit: {e}"
-                    ),
-                )
+                for spec, _ in batch:
+                    self._fail_task_returns(
+                        spec,
+                        ActorDiedError(
+                            f"actor {actor_id.hex()[:8]} task {spec.method_name} failed to submit: {e}"
+                        ),
+                    )
 
-    async def _push_actor_task_ordered(self, spec: TaskSpec):
-        """Issue the send in pump order; await the reply out-of-band.
+    async def _push_actor_batch_ordered(self, specs: list[TaskSpec], retried: bool = False):
+        """Issue one frame per task in pump order, then ONE transport flush
+        for the whole drain (each task keeps its own reply future, so a fast
+        call's result is never held behind a slow batchmate's).
 
         Ordering contract: wire order == pump order == submission order; the
         executor runs tasks in arrival order, so no sequence numbers are
         needed (the reference's ActorTaskSubmitter/ActorSchedulingQueue pair
         achieves the same with explicit seq_nos over unordered gRPC).
         """
-        entry = self._actor_conns.get(spec.actor_id)
+        actor_id = specs[0].actor_id
+        entry = self._actor_conns.get(actor_id)
         if entry is None:
-            entry = self._actor_conns[spec.actor_id] = {"addr": "", "conn": None}
+            entry = self._actor_conns[actor_id] = {"addr": "", "conn": None}
+        sent: list[tuple[TaskSpec, asyncio.Future]] = []
         try:
             if entry["conn"] is None or entry["conn"].closed:
                 if not entry["addr"]:
-                    await self._refresh_actor_addr(spec.actor_id, entry)
+                    await self._refresh_actor_addr(actor_id, entry)
                 entry["conn"] = await self._peer_conn(entry["addr"])
-            fut = entry["conn"].call_start("push_actor_task", {"spec": spec})
-            # Backpressure: bound the transport buffer before the next send.
+            for spec in specs:
+                sent.append((spec, entry["conn"].call_start("push_actor_task", {"spec": spec})))
+            # Backpressure: bound the transport buffer before the next drain.
             await entry["conn"].flush()
         except ActorDiedError:
             raise
         except (rpc.ConnectionLost, rpc.RpcError):
             # Stale address or send failure before execution could start:
-            # safe to retry through the reconnecting path (refreshes the
-            # address for restarted actors, honors max_task_retries).
+            # safe to retry (the redial refreshes the address for restarted
+            # actors; _refresh_actor_addr raises ActorDiedError for dead
+            # ones). One re-batch keeps the pipelined path; a second failure
+            # falls back to the serial per-task path.
             entry["conn"] = None
             entry["addr"] = ""
-            await self._push_actor_task(spec, attempt=0)
+            for fut in [f for _, f in sent]:
+                fut.cancel()
+            if not retried:
+                await self._push_actor_batch_ordered(specs, retried=True)
+            else:
+                for spec in specs:
+                    await self._push_actor_task(spec, attempt=0)
             return
-        asyncio.create_task(self._await_actor_reply(spec, fut, entry))
+        for spec, fut in sent:
+            asyncio.create_task(self._await_actor_reply(spec, fut, entry))
 
     async def _await_actor_reply(self, spec: TaskSpec, fut, entry):
         try:
@@ -1505,6 +1543,7 @@ class CoreWorker:
         if self._actor_runtime is None:
             raise rpc.RpcError("no actor hosted on this worker")
         return await self._actor_runtime.execute(p["spec"], conn)
+
 
     # -- compiled DAG stages (ray_tpu.dag; channels ride the existing peer
     # connections — reference: compiled_dag_node.py exec loops + channels) --

@@ -78,3 +78,36 @@ def test_group_parallelism_capped():
     three = time.perf_counter() - t0
     assert two < 1.55, f"2 naps should overlap on a 2-thread lane: {two:.2f}s"
     assert three >= 1.5, f"3 naps on a 2-thread lane must take 2 rounds: {three:.2f}s"
+
+
+def test_chained_actor_calls_do_not_deadlock():
+    """a.m2.remote(a.m1.remote()) lands both calls in one pump drain; the
+    dep on m1's result must not hold m1's send hostage (review regression)."""
+
+    @rt.remote
+    class Chain:
+        def m1(self):
+            return 5
+
+        def m2(self, x):
+            return x + 1
+
+    a = Chain.remote()
+    r1 = a.m1.remote()
+    r2 = a.m2.remote(r1)
+    assert rt.get(r2, timeout=30) == 6
+
+
+def test_inherited_method_decorator_honored():
+    class Base:
+        @rt.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    @rt.remote
+    class Child(Base):
+        pass
+
+    c = Child.remote()
+    r1, r2 = c.pair.remote()
+    assert rt.get([r1, r2], timeout=60) == [1, 2]
