@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Callable, Optional
 
 logger = logging.getLogger(__name__)
@@ -49,10 +50,13 @@ def system_memory_usage() -> float:
     return 1.0 - avail / total
 
 
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
 def worker_rss_bytes(pid: int) -> int:
     try:
         with open(f"/proc/{pid}/statm") as f:
-            return int(f.read().split()[1]) * 4096
+            return int(f.read().split()[1]) * _PAGE_SIZE
     except (OSError, ValueError, IndexError):
         return 0
 

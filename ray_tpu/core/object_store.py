@@ -69,6 +69,40 @@ class ObjectExistsError(Exception):
     pass
 
 
+class PinnedBuffer:
+    """A zero-copy view of a sealed arena object that holds its eviction pin.
+
+    Exports the buffer protocol (PEP 688): ``memoryview(pb)`` — and every
+    slice of it, and every ndarray pickle-5 reconstructs over those slices —
+    keeps this object alive through the exporter chain, so the pin drops
+    exactly when the last derived view is garbage-collected. Without this,
+    zero-copy reads would race LRU eviction overwriting live user data
+    (which is why _read_shm historically copied)."""
+
+    __slots__ = ("_view", "_store", "_oid")
+
+    def __init__(self, view: memoryview, store: "SharedMemoryClient", oid):
+        self._view = view
+        self._store = store
+        self._oid = oid
+
+    def __buffer__(self, flags):
+        # Read-only export: ndarrays reconstructed over these pages must not
+        # be able to mutate the sealed object other readers share (plasma
+        # maps client reads read-only for the same reason).
+        return memoryview(self._view).toreadonly()
+
+    def __len__(self):
+        return len(self._view)
+
+    def __del__(self):
+        try:
+            self._view.release()
+            self._store.release(self._oid)
+        except Exception:
+            pass
+
+
 class SharedMemoryClient:
     """Attach to (or create) a node's shm arena and do zero-copy object IO.
 
@@ -236,6 +270,18 @@ class SharedMemoryClient:
             return None
         return self._view[off : off + size.value]
 
+    def get_pinned(self, oid: ObjectID) -> "Optional[PinnedBuffer]":
+        """Zero-copy read whose pin lives as long as the buffer (and any
+        memoryview/ndarray derived from it): deserialization can wrap arena
+        pages directly — eviction/delete refuse pinned entries, so the pages
+        cannot be reused under a live view. The plasma-Buffer equivalent
+        (reference: plasma client Buffer holds the object reference until
+        destruction), done with PEP-688 __buffer__ instead of a C extension."""
+        view = self.get(oid)
+        if view is None:
+            return None
+        return PinnedBuffer(view, self, oid)
+
     def release(self, oid: ObjectID):
         self._lib.store_release(self._h, oid.binary())
 
@@ -254,6 +300,13 @@ class SharedMemoryClient:
 
     def contains_or_spilled(self, oid: ObjectID) -> bool:
         return self.contains(oid) or self.is_spilled(oid)
+
+    def reap(self, oid: ObjectID) -> bool:
+        """Delete if present; True when the object no longer exists (deleted
+        now or already gone), False ONLY while a pin defers the delete —
+        the retry-loop contract (plain delete() conflates missing with
+        pinned, which would retry tombstones forever)."""
+        return self._lib.store_delete(self._h, oid.binary()) != -2
 
     def delete(self, oid: ObjectID, drop_spilled: bool = False) -> bool:
         ok = self._lib.store_delete(self._h, oid.binary()) == 0

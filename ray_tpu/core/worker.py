@@ -26,6 +26,7 @@ main thread in workers); user code runs on executor threads.
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
 import hashlib
 import inspect
@@ -280,6 +281,9 @@ class CoreWorker:
         self._streaming: dict[bytes, "ObjectRefGenerator"] = {}
         # Executor side: consumer-ack state per backpressured stream.
         self._gen_ack_state: dict[bytes, dict] = {}
+        # Transient shm objects (dag zero-copy edges) whose delete was
+        # deferred because a consumer view still pins them; reaped later.
+        self._shm_garbage: list[ObjectID] = []
         self.task_events: list[dict] = []  # per-task event buffer (task_event_buffer.h equiv)
         self._events_reported = 0  # high-water mark shipped to the controller
         self._events_flush_lock = asyncio.Lock()
@@ -373,6 +377,8 @@ class CoreWorker:
             await asyncio.sleep(0.5)
             for sub in list(self._submitters.values()):
                 await sub.reap_idle(linger_s=2.0)
+            if self._shm_garbage and self.store is not None:
+                self._shm_garbage = [o for o in self._shm_garbage if not self.store.reap(o)]
             now = time.monotonic()
             if now - last_metrics >= self.config.metrics_report_interval_s:
                 last_metrics = now
@@ -873,18 +879,19 @@ class CoreWorker:
         await rec.ready_event.wait()
         return rec.state == "READY"
 
-    def _read_shm(self, oid: ObjectID) -> bytes | None:
+    def _read_shm(self, oid: ObjectID):
         """Read an object payload out of the shared-memory arena.
 
-        Copies while pinned: handing out views backed by unpinned arena pages
-        would let LRU eviction overwrite live user data. True zero-copy reads
-        need a buffer type whose destructor drops the pin (plasma's Buffer
-        object); planned as a small CPython C extension.
+        Zero-copy: returns a PinnedBuffer whose eviction pin lives as long
+        as any view deserialization derives from it (ndarrays reconstructed
+        from pickle-5 out-of-band buffers wrap the arena pages directly; the
+        pin drops when the last one is collected). Spilled objects come back
+        as plain bytes off disk.
         """
         if self.store is None:
             return None
-        view = self.store.get(oid)
-        if view is None:  # spilled? restore (or read straight off disk if full)
+        buf = self.store.get_pinned(oid)
+        if buf is None:  # spilled? restore (or read straight off disk if full)
             evicted: list = []
             restored = self.store.restore(oid, evicted_out=evicted)
             if evicted:
@@ -895,16 +902,10 @@ class CoreWorker:
                     if self.loop is not None:
                         asyncio.run_coroutine_threadsafe(self._report_evicted(evicted), self.loop)
             if restored:
-                view = self.store.get(oid)
+                buf = self.store.get_pinned(oid)
             else:
                 return self.store.read_spilled(oid)
-        if view is None:
-            return None
-        try:
-            return bytes(view)
-        finally:
-            view.release()
-            self.store.release(oid)
+        return buf
 
     async def _pull_to_local(self, oid: ObjectID) -> bool:
         if self.daemon is None:
@@ -1389,9 +1390,17 @@ class CoreWorker:
             # keeps its own reply future).
             while len(batch) < 64 and not q.empty():
                 batch.append(q.get_nowait())
+            # Failure ownership: _push_actor_batch_ordered fails ITS specs'
+            # returns itself (raising only ActorDiedError, for retirement);
+            # the pump fails exactly the items it has not yet handed over —
+            # never work already flushed to the actor, whose reply futures
+            # own the outcome.
+            pending = collections.deque(batch)
+            specs: list[TaskSpec] = []
+            died: ActorDiedError | None = None
             try:
-                specs = []
-                for spec, dep_refs in batch:
+                while pending:
+                    spec, dep_refs = pending[0]
                     if dep_refs:
                         # Ship everything accumulated BEFORE awaiting this
                         # task's deps: a dep may be produced by an earlier
@@ -1399,38 +1408,46 @@ class CoreWorker:
                         # one drain) — holding m1 unsent while waiting on its
                         # result would deadlock the pump.
                         if specs:
-                            await self._push_actor_batch_ordered(specs)
-                            specs = []
+                            to_push, specs = specs, []
+                            await self._push_actor_batch_ordered(to_push)
                         self._inflight_deps[spec.task_id.binary()] = dep_refs
-                        await self._wait_deps(dep_refs)
+                        try:
+                            await self._wait_deps(dep_refs)
+                        except Exception as e:
+                            pending.popleft()
+                            self._fail_task_returns(
+                                spec,
+                                RemoteError(f"task {spec.method_name} dependency resolution failed: {e}"),
+                            )
+                            continue
+                    pending.popleft()
                     specs.append(spec)
                 if specs:
-                    await self._push_actor_batch_ordered(specs)
+                    to_push, specs = specs, []
+                    await self._push_actor_batch_ordered(to_push)
             except ActorDiedError as e:
-                for spec, _ in batch:
-                    self._fail_task_returns(spec, e)
+                died = e
+            if died is not None:
+                for spec, _ in pending:  # drained but never handed to a push
+                    self._fail_task_returns(spec, died)
                 # Actor is gone: fail everything still queued and retire the
                 # pump (a later submission spawns a fresh one, which handles
                 # the restarted-actor case via address refresh).
                 while not q.empty():
                     pending_spec, _ = q.get_nowait()
-                    self._fail_task_returns(pending_spec, e)
+                    self._fail_task_returns(pending_spec, died)
                 if self._actor_send_queues.get(actor_id) is q:
                     del self._actor_send_queues[actor_id]
                 return
-            except Exception as e:  # keep the pump alive for later tasks
-                for spec, _ in batch:
-                    self._fail_task_returns(
-                        spec,
-                        ActorDiedError(
-                            f"actor {actor_id.hex()[:8]} task {spec.method_name} failed to submit: {e}"
-                        ),
-                    )
 
     async def _push_actor_batch_ordered(self, specs: list[TaskSpec], retried: bool = False):
         """Issue one frame per task in pump order, then ONE transport flush
         for the whole drain (each task keeps its own reply future, so a fast
         call's result is never held behind a slow batchmate's).
+
+        Failure ownership: every spec handed to this method gets an outcome
+        here — a reply-awaiting task, a retry, or failed returns. Only
+        ActorDiedError escapes (so the pump can retire).
 
         Ordering contract: wire order == pump order == submission order; the
         executor runs tasks in arrival order, so no sequence numbers are
@@ -1451,23 +1468,40 @@ class CoreWorker:
                 sent.append((spec, entry["conn"].call_start("push_actor_task", {"spec": spec})))
             # Backpressure: bound the transport buffer before the next drain.
             await entry["conn"].flush()
-        except ActorDiedError:
+        except ActorDiedError as e:
+            for spec in specs:
+                self._fail_task_returns(spec, e)
             raise
-        except (rpc.ConnectionLost, rpc.RpcError):
-            # Stale address or send failure before execution could start:
-            # safe to retry (the redial refreshes the address for restarted
-            # actors; _refresh_actor_addr raises ActorDiedError for dead
-            # ones). One re-batch keeps the pipelined path; a second failure
-            # falls back to the serial per-task path.
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
             entry["conn"] = None
             entry["addr"] = ""
             for fut in [f for _, f in sent]:
                 fut.cancel()
-            if not retried:
+            if not sent and not retried:
+                # Nothing reached the wire (stale address / dial failure):
+                # unambiguously safe to retry the whole batch once through
+                # the redial path (which refreshes restarted-actor addresses;
+                # _refresh_actor_addr raises ActorDiedError for dead ones).
                 await self._push_actor_batch_ordered(specs, retried=True)
-            else:
-                for spec in specs:
-                    await self._push_actor_task(spec, attempt=0)
+                return
+            # Frames may have been DELIVERED and executed before the drop
+            # (TCP delivery is independent of the local error): resending
+            # would double-execute non-idempotent methods. Per-task policy,
+            # same as a reply lost mid-flight: retry only with the user's
+            # opt-in (max_task_retries > 0), else at-most-once wins.
+            for spec in specs:
+                if getattr(spec.options, "max_task_retries", 0) > 0:
+                    try:
+                        await self._push_actor_task(spec, attempt=1)
+                    except ActorDiedError as e2:
+                        self._fail_task_returns(spec, e2)
+                else:
+                    self._fail_task_returns(
+                        spec,
+                        ActorDiedError(
+                            f"actor {spec.actor_id.hex()[:8]} task {spec.method_name} lost in flight: {e}"
+                        ),
+                    )
             return
         for spec, fut in sent:
             asyncio.create_task(self._await_actor_reply(spec, fut, entry))
@@ -1561,6 +1595,15 @@ class CoreWorker:
         from ray_tpu.dag.runtime import dag_teardown
 
         return dag_teardown(self, p)
+
+    def handle_store_path(self, conn, p):
+        """Arena identity probe: same path = same node = zero-copy dag edges."""
+        return self.store.path if self.store is not None else ""
+
+    def handle_dag_shm_ack(self, conn, p):
+        from ray_tpu.dag.runtime import dag_shm_ack
+
+        return dag_shm_ack(self, p)
 
     def handle_dag_result(self, conn, p):
         from ray_tpu.dag.runtime import dag_result

@@ -62,6 +62,16 @@ def dag_teardown(core, p):
     stages = _dag_tables(core)
     for key in [k for k in stages if k[0] == p["dag_id"]]:
         del stages[key]
+    # Unacked zero-copy edge values of this dag: drop producer pins, reap.
+    from ray_tpu.core.ids import ObjectID
+
+    out = _shm_out(core)
+    for oid_b in [o for o, e in out.items() if e["dag_id"] == p["dag_id"]]:
+        entry = out.pop(oid_b)
+        entry["buffer"] = None
+        oid = ObjectID(oid_b)
+        if core.store is not None and not core.store.reap(oid):
+            core._shm_garbage.append(oid)
     return True
 
 
@@ -73,7 +83,10 @@ async def dag_push(core, conn, p):
         return False  # torn down
     seq = p["seq"]
     slot_map = st.pending.setdefault(seq, {})
-    slot_map[p["slot"]] = (p["blob"], p["is_error"])
+    if "shm_oid" in p:
+        slot_map[p["slot"]] = (_ShmValue(p["shm_oid"], conn), p["is_error"])
+    else:
+        slot_map[p["slot"]] = (p["blob"], p["is_error"])
     if len(slot_map) < st.spec["n_inputs"]:
         return True
     del st.pending[seq]
@@ -81,17 +94,50 @@ async def dag_push(core, conn, p):
     return True
 
 
+class _ShmValue:
+    """Marker for an input riding the shared arena: oid + the producer conn
+    to ack on once the stage has consumed it."""
+
+    __slots__ = ("oid", "conn")
+
+    def __init__(self, oid: bytes, conn):
+        self.oid = oid
+        self.conn = conn
+
+
 async def _run_stage(core, spec: dict, seq: int, slot_map: dict):
-    # Error propagation: any errored input short-circuits the stage.
+    # Error propagation: any errored input short-circuits the stage — but
+    # shm-riding inputs must still be acked or their producer pins leak.
     err_blob = next((blob for blob, is_err in slot_map.values() if is_err), None)
     if err_blob is not None:
+        for blob, _ in slot_map.values():
+            if isinstance(blob, _ShmValue):
+                try:
+                    await blob.conn.notify("dag_shm_ack", {"oid": blob.oid})
+                except Exception:
+                    pass
         await _emit(core, spec, seq, err_blob, is_error=True)
         return
     runtime = core._actor_runtime
+    acks: list[_ShmValue] = []
     try:
         if runtime is None or runtime.spec.actor_id != ActorID(spec["actor_id"]):
             raise RuntimeError("dag stage actor is not hosted on this worker")
-        values = {slot: serialization.deserialize(blob) for slot, (blob, _) in slot_map.items()}
+        from ray_tpu.core.ids import ObjectID
+
+        # Register ALL shm inputs for acking up front: if one slot's read or
+        # deserialize fails, the others' producer pins must still be released
+        # (an unacked pin survives until dag teardown otherwise).
+        acks.extend(b for b, _ in slot_map.values() if isinstance(b, _ShmValue))
+        values = {}
+        for slot, (blob, _) in slot_map.items():
+            if isinstance(blob, _ShmValue):
+                pinned = core.store.get_pinned(ObjectID(blob.oid))
+                if pinned is None:
+                    raise RuntimeError("dag shm value lost before consumption")
+                values[slot] = serialization.deserialize(pinned)
+            else:
+                values[slot] = serialization.deserialize(blob)
         args = [values[a[1]] if a[0] == "slot" else a[1] for a in spec["arg_layout"]]
         method = getattr(runtime.instance, spec["method"])
         loop = asyncio.get_running_loop()
@@ -109,15 +155,131 @@ async def _run_stage(core, spec: dict, seq: int, slot_map: dict):
         err = serialization.RemoteError.from_exception(e, where=f"dag stage {spec['method']}")
         blob, _ = serialization.serialize(err.cause if err.cause is not None else err)
         await _emit(core, spec, seq, blob, is_error=True)
+    finally:
+        for sv in acks:
+            try:
+                await sv.conn.notify("dag_shm_ack", {"oid": sv.oid})
+            except Exception:
+                pass
+
+
+async def _same_arena(core, addr: str) -> bool:
+    """True when the peer worker maps the same shm arena (same node) —
+    cached per address. Positive answers cache forever (arena identity is
+    stable); a failed probe caches negative only briefly, so a transient
+    startup race cannot disable the zero-copy path for the process
+    lifetime."""
+    import time as _time
+
+    cache = getattr(core, "_same_store_cache", None)
+    if cache is None:
+        cache = core._same_store_cache = {}
+    hit = cache.get(addr)
+    if hit is not None:
+        same, expires = hit
+        if same or expires is None or _time.monotonic() < expires:
+            return same
+    if core.store is None:
+        cache[addr] = (False, None)  # no arena at all: permanent
+        return False
+    try:
+        conn = await core._peer_conn(addr)
+        peer_path = await conn.call("store_path", {})
+        same = bool(peer_path) and peer_path == core.store.path
+        cache[addr] = (same, None)  # definitive answer from the peer
+    except Exception:
+        same = False
+        cache[addr] = (False, _time.monotonic() + 15.0)  # re-probe later
+    return same
 
 
 async def _emit(core, spec: dict, seq: int, blob: bytes, is_error: bool):
+    """Ship a stage output downstream. Same-node edges with large payloads
+    ride the shared-memory arena zero-copy (the mutable-plasma channel
+    equivalent — reference: experimental/channel/shared_memory_channel.py):
+    one scatter-write into shm by the producer, consumers deserialize
+    ndarrays directly over the pinned pages; the producer holds a pin until
+    the consumer acks, then the transient object is deleted (deferred while
+    consumer views keep it pinned). Cross-node / small payloads ship inline
+    in the notify frame."""
+    from ray_tpu.core.ids import ObjectID
+
+    # One arena write serves every same-node consumer (fan-out of k shares a
+    # single object; the producer pin drops after k acks) — duplicating the
+    # payload per edge would multiply both the memcpy and capacity pressure.
+    shm_targets = []
+    if not is_error and core.store is not None and len(blob) > core.config.max_inline_object_size:
+        for tgt in spec["downstream"]:
+            if await _same_arena(core, tgt[0]):
+                shm_targets.append(tgt)
+    shm_oid = None
+    if shm_targets:
+        oid = ObjectID.from_put()
+        try:
+            buf, evicted = core.store.create_autoevict(oid, len(blob))
+            buf[:] = blob
+            del buf
+            core.store.seal(oid)
+            if evicted:
+                await core._report_evicted(evicted)
+            # Producer pin: guarantees the object survives until all acks.
+            _shm_out(core)[oid.binary()] = {
+                "dag_id": spec["dag_id"],
+                "buffer": core.store.get_pinned(oid),
+                "acks_left": len(shm_targets),
+            }
+            shm_oid = oid.binary()
+            _shm_edge_counter().inc(len(shm_targets))
+        except Exception:
+            shm_oid = None  # arena full: everything falls back to frames
+
     for addr, stage, slot in spec["downstream"]:
         conn = await core._peer_conn(addr)
-        await conn.notify(
-            "dag_push",
-            {"dag_id": spec["dag_id"], "stage_id": stage, "seq": seq, "slot": slot, "blob": blob, "is_error": is_error},
-        )
+        msg = {"dag_id": spec["dag_id"], "stage_id": stage, "seq": seq, "slot": slot, "is_error": is_error}
+        if shm_oid is not None and (addr, stage, slot) in shm_targets:
+            msg["shm_oid"] = shm_oid
+        else:
+            msg["blob"] = blob
+        await conn.notify("dag_push", msg)
     if spec["to_driver"]:
         conn = await core._peer_conn(spec["to_driver"])
         await conn.notify("dag_result", {"dag_id": spec["dag_id"], "seq": seq, "blob": blob})
+
+
+_shm_edges = None
+
+
+def _shm_edge_counter():
+    global _shm_edges
+    if _shm_edges is None:
+        from ray_tpu.util.metrics import Counter
+
+        _shm_edges = Counter("dag_shm_edges", "dag values shipped via the shared arena")
+    return _shm_edges
+
+
+def _shm_out(core) -> dict:
+    if not hasattr(core, "_dag_shm_out"):
+        core._dag_shm_out = {}
+    return core._dag_shm_out
+
+
+def dag_shm_ack(core, p):
+    """Producer side: a consumer finished its stage. The last ack drops the
+    producer pin and reaps the transient object (deferred to the reaper
+    while consumer value-views still pin it; reap() distinguishes pinned
+    from already-gone, so late/duplicate acks cannot loop forever)."""
+    out = _shm_out(core)
+    entry = out.get(p["oid"])
+    if entry is not None:
+        entry["acks_left"] -= 1
+        if entry["acks_left"] > 0:
+            return True
+        del out[p["oid"]]
+        entry["buffer"] = None  # drop the producer pin
+    from ray_tpu.core.ids import ObjectID
+
+    oid = ObjectID(p["oid"])
+    if core.store is not None and not core.store.reap(oid):
+        core._shm_garbage.append(oid)
+    return True

@@ -113,3 +113,78 @@ def test_faster_than_driver_round_trips(dag_actors):
         assert dag_time < classic_time, (dag_time, classic_time)
     finally:
         dag.teardown()
+
+
+def test_large_tensor_rides_shm_channel(dag_actors, shared_ray):
+    """A multi-MB ndarray between same-node stages moves through the shared
+    arena (zero-copy channel; reference: shared_memory_channel.py), and the
+    transient channel objects are acked + deleted afterwards."""
+    import gc
+    import time as _time
+
+    import numpy as np
+
+    from ray_tpu.core import api as _api
+
+    d, a = dag_actors
+    with InputNode() as inp:
+        out = a.apply.bind(d.apply.bind(inp))
+    compiled = out.experimental_compile()
+    try:
+        x = np.ones(1 << 20, dtype=np.float64)  # 8MB >> inline cap
+        res = compiled.execute(x).result(timeout=120)
+        np.testing.assert_array_equal(res, x * 2 + 10)
+        res2 = compiled.execute(x * 3).result(timeout=120)
+        np.testing.assert_array_equal(res2, x * 6 + 10)
+    finally:
+        compiled.teardown()
+    # Transient edge objects must be reclaimed once consumers acked.
+    del res, res2
+    gc.collect()
+    store = _api._require_worker().store
+    deadline = _time.time() + 15
+    while _time.time() < deadline:
+        leaked = [
+            oid for oid, _size in store.list_objects()
+            if oid.return_index() == 2**32 - 1  # put-style ids (dag transients + puts)
+        ]
+        if not leaked:
+            break
+        _time.sleep(0.3)
+    # The driver's own puts may linger (owned refs); what must NOT linger
+    # grows unboundedly with executions — allow a small constant.
+    assert len(leaked) <= 2, f"dag shm channel leaked {len(leaked)} objects"
+
+
+def test_shm_channel_path_actually_used(dag_actors, shared_ray):
+    """The dag_shm_edges counter must tick for large same-node payloads —
+    guards against the zero-copy path silently regressing to socket frames."""
+    import time as _time
+
+    import numpy as np
+
+    from ray_tpu.core import api as _api
+
+    d, a = dag_actors
+    with InputNode() as inp:
+        out = a.apply.bind(d.apply.bind(inp))
+    compiled = out.experimental_compile()
+    try:
+        x = np.ones(1 << 20, dtype=np.float64)
+        compiled.execute(x).result(timeout=120)
+    finally:
+        compiled.teardown()
+    core = _api._require_worker()
+    deadline = _time.time() + 20  # metrics ship on a short timer
+    total = 0
+    while _time.time() < deadline:
+        m = core._run(core.controller.call("get_metrics", {}))
+        total = sum(
+            s.get("value", 0)
+            for s in (m if isinstance(m, list) else [])
+            if isinstance(s, dict) and s.get("name") == "dag_shm_edges"
+        )
+        if total >= 1:
+            break
+        _time.sleep(0.5)
+    assert total >= 1, f"shm edge counter never ticked: {m}"

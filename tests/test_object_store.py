@@ -119,3 +119,32 @@ def test_memory_store():
     assert ms.get(oid) == b"v"
     ms.delete(oid)
     assert not ms.contains(oid)
+
+
+def test_pinned_buffer_zero_copy_get():
+    """get() of a big ndarray views the arena zero-copy: the array is
+    read-only, the object stays pinned (undeletable) while the array lives,
+    and the pin drops when the array is collected."""
+    import gc
+
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu.core import api as _api
+
+    rt.init(num_cpus=1, object_store_memory=64 * 1024 * 1024)
+    try:
+        src = np.arange(1 << 20, dtype=np.int64)  # 8MB, well over inline cap
+        ref = rt.put(src)
+        arr = rt.get(ref, timeout=60)
+        np.testing.assert_array_equal(arr, src)
+        assert not arr.flags.writeable  # shared pages must be read-only
+        store = _api._require_worker().store
+        assert store is not None
+        # Pinned by the live view: delete must refuse.
+        assert not store.delete(ref.id)
+        del arr
+        gc.collect()
+        assert store.delete(ref.id)  # pin dropped with the last view
+    finally:
+        rt.shutdown()
