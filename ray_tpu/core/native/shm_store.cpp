@@ -322,6 +322,22 @@ int store_seal(void* sv, const uint8_t* id) {
   return 0;
 }
 
+// Seal while KEEPING the writer pin as the caller's read pin (atomic under
+// the arena mutex): a transient value handed to same-arena consumers must
+// never have an unpinned window in which another process's create_autoevict
+// could LRU-evict it between seal and re-pin. Returns payload offset, -1 if
+// absent/not-in-created-state.
+int64_t store_seal_pinned(void* sv, const uint8_t* id, uint64_t* size_out) {
+  Store* s = reinterpret_cast<Store*>(sv);
+  Guard g(s->hdr);
+  Entry* e = find_entry(s->hdr, id);
+  if (!e || e->state != kCreated) return -1;
+  e->state = kSealed;
+  e->lru_tick = s->hdr->lru_counter++;
+  if (size_out) *size_out = e->size;
+  return (int64_t)(s->hdr->data_start + e->offset);
+}
+
 // Returns absolute file offset (payload) and size; pins the object. -1 = absent/unsealed.
 int64_t store_get(void* sv, const uint8_t* id, uint64_t* size_out) {
   Store* s = reinterpret_cast<Store*>(sv);

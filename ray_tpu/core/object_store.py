@@ -38,6 +38,10 @@ class _Lib:
                 lib.store_create_obj.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
                 lib.store_seal.restype = ctypes.c_int
                 lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.store_seal_pinned.restype = ctypes.c_int64
+                lib.store_seal_pinned.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)
+                ]
                 lib.store_get.restype = ctypes.c_int64
                 lib.store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
                 lib.store_release.restype = ctypes.c_int
@@ -269,6 +273,17 @@ class SharedMemoryClient:
         if off < 0:
             return None
         return self._view[off : off + size.value]
+
+    def seal_pinned(self, oid: ObjectID) -> "Optional[PinnedBuffer]":
+        """Seal a just-written object and atomically keep it pinned (the
+        writer pin becomes the returned buffer's read pin) — no window in
+        which another arena client's eviction could reap it."""
+        size = ctypes.c_uint64()
+        with self._lock:
+            off = self._lib.store_seal_pinned(self._h, oid.binary(), ctypes.byref(size))
+        if off < 0:
+            return None
+        return PinnedBuffer(self._view[off : off + size.value], self, oid)
 
     def get_pinned(self, oid: ObjectID) -> "Optional[PinnedBuffer]":
         """Zero-copy read whose pin lives as long as the buffer (and any

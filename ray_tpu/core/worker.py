@@ -1427,6 +1427,19 @@ class CoreWorker:
                     await self._push_actor_batch_ordered(to_push)
             except ActorDiedError as e:
                 died = e
+            except Exception as e:
+                # Safety net: an unexpected error must not kill the pump task
+                # while its queue stays registered (later submissions would
+                # enqueue into a dead pump and hang forever). Fail the
+                # un-pushed work; the pump lives on for the next drain.
+                logger.exception("actor send pump error (actor=%s)", actor_id.hex()[:8])
+                for spec, _ in pending:
+                    self._fail_task_returns(
+                        spec,
+                        ActorDiedError(
+                            f"actor {actor_id.hex()[:8]} task {spec.method_name} failed to submit: {e}"
+                        ),
+                    )
             if died is not None:
                 for spec, _ in pending:  # drained but never handed to a push
                     self._fail_task_returns(spec, died)
@@ -1472,7 +1485,9 @@ class CoreWorker:
             for spec in specs:
                 self._fail_task_returns(spec, e)
             raise
-        except (rpc.ConnectionLost, rpc.RpcError) as e:
+        except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+            # OSError covers raw transport errors (ConnectionResetError from
+            # writer.drain()) that the rpc layer does not wrap.
             entry["conn"] = None
             entry["addr"] = ""
             for fut in [f for _, f in sent]:

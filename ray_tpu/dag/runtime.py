@@ -219,17 +219,20 @@ async def _emit(core, spec: dict, seq: int, blob: bytes, is_error: bool):
             buf, evicted = core.store.create_autoevict(oid, len(blob))
             buf[:] = blob
             del buf
-            core.store.seal(oid)
+            # Atomic seal+pin: no unpinned window in which a concurrent
+            # arena client's eviction could reap the value before consumers
+            # read it (the producer pin survives until the last ack).
+            pinned = core.store.seal_pinned(oid)
             if evicted:
                 await core._report_evicted(evicted)
-            # Producer pin: guarantees the object survives until all acks.
-            _shm_out(core)[oid.binary()] = {
-                "dag_id": spec["dag_id"],
-                "buffer": core.store.get_pinned(oid),
-                "acks_left": len(shm_targets),
-            }
-            shm_oid = oid.binary()
-            _shm_edge_counter().inc(len(shm_targets))
+            if pinned is not None:
+                _shm_out(core)[oid.binary()] = {
+                    "dag_id": spec["dag_id"],
+                    "buffer": pinned,
+                    "acks_left": len(shm_targets),
+                }
+                shm_oid = oid.binary()
+                _shm_edge_counter().inc(len(shm_targets))
         except Exception:
             shm_oid = None  # arena full: everything falls back to frames
 

@@ -85,6 +85,38 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return self._chain("limit", n=n)
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned column merge with an equal-length dataset (reference:
+        Dataset.zip); colliding column names from the right side get a _1
+        suffix."""
+        return Dataset(
+            LogicalOp("zip", inputs=[self._leaf, other._leaf]),
+            self._max_in_flight,
+        )
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample).
+
+        Seeded sampling derives each batch's stream from (seed, batch
+        content): deterministic for a given dataset, but NOT the same mask
+        replayed per batch (reseeding identically every batch would keep the
+        same row positions everywhere — periodic, biased sampling)."""
+        import zlib
+
+        import numpy as _np
+
+        def sample(batch, _frac=float(fraction), _seed=seed):
+            n = len(next(iter(batch.values()))) if batch else 0
+            if _seed is None:
+                rng = _np.random.default_rng()
+            else:
+                first = _np.ascontiguousarray(next(iter(batch.values()))) if batch else _np.empty(0)
+                rng = _np.random.default_rng([_seed, zlib.crc32(first.tobytes())])
+            keep = rng.random(n) < _frac
+            return {k: _np.asarray(v)[keep] for k, v in batch.items()}
+
+        return self.map_batches(sample)
+
     def union(self, *others: "Dataset") -> "Dataset":
         return Dataset(
             LogicalOp("union", inputs=[self._leaf] + [o._leaf for o in others]),
@@ -115,6 +147,41 @@ class Dataset:
         yield from batches_from_blocks(
             self.iter_blocks(), batch_size, batch_format, drop_last
         )
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           dtypes=None, device=None) -> Iterator:
+        """Batches as dicts of torch tensors (reference:
+        Dataset.iter_torch_batches; torch is CPU-only in this image)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                # Zero-copy reads hand out read-only arrays; torch tensors
+                # must be writable, so copy those (cheap relative to the
+                # host->accelerator step that follows in real training).
+                if hasattr(v, "flags") and not v.flags.writeable:
+                    v = v.copy()
+                t = torch.as_tensor(v)
+                if dtypes is not None:
+                    t = t.to(dtypes[k] if isinstance(dtypes, dict) else dtypes)
+                if device is not None:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
+    def to_pandas(self, limit: Optional[int] = None):
+        """Collect into one pandas DataFrame (reference: Dataset.to_pandas)."""
+        import pandas as pd
+
+        ds = self.limit(limit) if limit is not None else self
+        blocks = list(ds.iter_blocks())
+        if not blocks:
+            return pd.DataFrame()
+        return B.concat_blocks(blocks).to_pandas()
 
     # -- consumption --------------------------------------------------------
     def take(self, n: int = 20) -> list[dict]:

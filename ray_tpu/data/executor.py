@@ -87,6 +87,8 @@ class StreamingExecutor:
                     *(self._run_chain(p.chain_from_source())
                       for p in op.inputs[1:]),
                 )
+            elif op.kind == "zip":
+                stream = self._zip(stream, op)
             else:
                 stream = self._all_to_all(stream, op)
         return self._mapped_stream(stream, seg)
@@ -197,6 +199,17 @@ class StreamingExecutor:
 
         yield rt.remote(_groupby_all).remote(key, agg_fn, *refs)
 
+    def _zip(self, stream: Iterator, op: LogicalOp) -> Iterator:
+        """Row-aligned column merge of two datasets of equal length
+        (reference: Dataset.zip). Both sides barrier, then one task builds
+        the merged blocks (column collision: right side wins with a _1
+        suffix like the reference)."""
+        import ray_tpu as rt
+
+        left = list(stream)
+        right = list(self._run_chain(op.inputs[1].chain_from_source()))
+        yield rt.remote(_zip_all).remote(len(left), *(left + right))
+
 
 _num_rows_remote = None
 
@@ -242,6 +255,21 @@ def _take_global(indices: "np.ndarray", counts: list[int], *blocks):
     inverse = np.empty(len(order), dtype=np.int64)
     inverse[order] = np.arange(len(order))
     return B.block_take(merged, inverse)
+
+
+def _zip_all(n_left: int, *blocks):
+    left = B.concat_blocks(list(blocks[:n_left]))
+    right = B.concat_blocks(list(blocks[n_left:]))
+    if left.num_rows != right.num_rows:
+        raise ValueError(
+            f"zip requires equal row counts, got {left.num_rows} vs {right.num_rows}"
+        )
+    out = left
+    existing = set(left.column_names)
+    for name in right.column_names:
+        col = right.column(name)
+        out = out.append_column(name + "_1" if name in existing else name, col)
+    return out
 
 
 def _sort_all(key: str, descending: bool, *blocks):

@@ -250,3 +250,49 @@ def test_prefetch_to_device(shared_ray):
     assert all(isinstance(b["id"], jax.Array) for b in out)
     assert int(out[0]["id"].sum() + out[1]["id"].sum()
                + out[2]["id"].sum() + out[3]["id"].sum()) == sum(range(32))
+
+
+def test_zip(shared_ray):
+    import ray_tpu.data as rd
+
+    a = rd.range(20)
+    b = rd.range(20).map(lambda r: {"sq": r["id"] ** 2})
+    rows = a.zip(b).take_all()
+    assert len(rows) == 20
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+
+
+def test_zip_name_collision_and_length_mismatch(shared_ray):
+    import pytest as _pytest
+
+    import ray_tpu.data as rd
+
+    rows = rd.range(5).zip(rd.range(5)).take_all()
+    assert set(rows[0]) == {"id", "id_1"}
+    with _pytest.raises(Exception, match="equal row counts"):
+        rd.range(4).zip(rd.range(5)).take_all()
+
+
+def test_random_sample(shared_ray):
+    import ray_tpu.data as rd
+
+    n = rd.range(2000).random_sample(0.25, seed=7).count()
+    assert 350 < n < 650  # ~500 expected
+
+
+def test_iter_torch_batches(shared_ray):
+    import torch
+
+    import ray_tpu.data as rd
+
+    batches = list(rd.range(100).iter_torch_batches(batch_size=40))
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    assert sum(len(b["id"]) for b in batches) == 100
+
+
+def test_to_pandas(shared_ray):
+    import ray_tpu.data as rd
+
+    df = rd.range(10).map(lambda r: {"id": r["id"], "y": r["id"] * 2}).to_pandas()
+    assert len(df) == 10 and list(df.columns) == ["id", "y"]
+    assert (df["y"] == df["id"] * 2).all()
