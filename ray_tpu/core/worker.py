@@ -1518,6 +1518,21 @@ class CoreWorker:
                         ),
                     )
             return
+        except Exception as e:
+            # Uphold the ownership contract for errors outside the expected
+            # set too (every spec handed here gets an outcome): otherwise the
+            # callers' reply futures never resolve.
+            logger.exception("actor batch push failed (actor=%s)", actor_id.hex()[:8])
+            for fut in [f for _, f in sent]:
+                fut.cancel()
+            for spec in specs:
+                self._fail_task_returns(
+                    spec,
+                    ActorDiedError(
+                        f"actor {actor_id.hex()[:8]} task {spec.method_name} failed to submit: {e}"
+                    ),
+                )
+            return
         for spec, fut in sent:
             asyncio.create_task(self._await_actor_reply(spec, fut, entry))
 

@@ -111,7 +111,13 @@ class Dataset:
                 rng = _np.random.default_rng()
             else:
                 first = _np.ascontiguousarray(next(iter(batch.values()))) if batch else _np.empty(0)
-                rng = _np.random.default_rng([_seed, zlib.crc32(first.tobytes())])
+                if first.dtype == object:
+                    # Ragged columns: tobytes() would hash PyObject POINTERS
+                    # (different every run); hash the contents instead.
+                    ent = zlib.crc32(repr(first.tolist()).encode())
+                else:
+                    ent = zlib.crc32(first.tobytes())
+                rng = _np.random.default_rng([_seed, ent])
             keep = rng.random(n) < _frac
             return {k: _np.asarray(v)[keep] for k, v in batch.items()}
 

@@ -149,6 +149,9 @@ class LLMEngine:
         self.buckets = tuple(
             sorted({min(b, S) for b in self.ec.prefill_buckets if b <= S} | {S})
         )
+        # Prefill group sizes, largest-first (greedy grouping caps the
+        # number of compiled (bucket, k) programs at |buckets| x |k_buckets|).
+        self.k_buckets = (8, 4, 2, 1)
 
     # -- jitted programs ---------------------------------------------------
     def _prefill_impl(self, params, cache_k, cache_v, tokens, length, slot, key):
@@ -201,11 +204,77 @@ class LLMEngine:
         )
         return cache_k, cache_v, toks, last, lengths
 
-    def _prefill(self, bucket: int):
-        fn = self._prefill_jit.get(bucket)
+    def _prefill_batch_impl(self, params, cache_k, cache_v, tokens, lengths, slots, key):
+        """Prefill k requests of one length bucket in ONE device program
+        (scan over requests around the single-request body): one host round
+        trip per admitted group instead of one per request — on a
+        remote/tunneled chip the per-call latency dominates prefill compute,
+        so this is the main TTFT lever under load. tokens: [k, P]."""
+        keys = jax.random.split(key, tokens.shape[0])
+
+        def scan_req(carry, xs):
+            ck, cv = carry
+            toks_i, len_i, slot_i, key_i = xs
+            ck, cv, tok = self._prefill_impl(params, ck, cv, toks_i, len_i, slot_i, key_i)
+            return (ck, cv), tok
+
+        (cache_k, cache_v), toks = jax.lax.scan(
+            scan_req, (cache_k, cache_v), (tokens, lengths, slots, keys)
+        )
+        return cache_k, cache_v, toks  # toks: [k]
+
+    def _prefill(self, bucket: int, k: int):
+        fn = self._prefill_jit.get((bucket, k))
         if fn is None:
-            fn = self._prefill_jit[bucket] = jax.jit(self._prefill_impl, donate_argnums=(1, 2))
+            fn = self._prefill_jit[(bucket, k)] = jax.jit(
+                self._prefill_batch_impl, donate_argnums=(1, 2)
+            )
         return fn
+
+    def warmup(self, buckets=None, k_values=None):
+        """Compile every (bucket, k) prefill program and the decode block
+        before serving (the vLLM-style startup warmup): a cold compile costs
+        seconds and would otherwise land inside the first loaded requests'
+        TTFT. Executes each program once with zero-length dummy requests into
+        slot 0 (cache contents are irrelevant while slot lengths stay 0)."""
+        import jax.numpy as jnp
+
+        if buckets is None:
+            buckets = self.buckets
+        else:
+            # Snap caller lengths (e.g. a raw prompt length) to the buckets
+            # admit actually selects — warming a bucket step() never uses
+            # while leaving the real one cold would defeat the purpose.
+            buckets = tuple(
+                sorted({next(b for b in self.buckets if b >= min(x, self.buckets[-1]))
+                        for x in buckets})
+            )
+        k_values = tuple(k_values) if k_values is not None else self.k_buckets
+        key = jax.random.PRNGKey(0)
+        for b in buckets:
+            for k in k_values:
+                toks = jnp.zeros((k, b), jnp.int32)
+                lens = jnp.ones(k, jnp.int32)
+                idxs = jnp.zeros(k, jnp.int32)
+                self.cache_k, self.cache_v, td = self._prefill(b, k)(
+                    self.params, self.cache_k, self.cache_v, toks, lens, idxs, key
+                )
+                # The admit path's per-group mirror updates are their own tiny
+                # jitted programs, one shape variant per k — compile them here
+                # too or they land in the first loaded step's TTFT.
+                self.d_lengths = self.d_lengths.at[idxs].set(lens)
+                self.d_last = self.d_last.at[idxs].set(td)
+                jax.device_get(td)
+        out = self._decode_jit(
+            self.params, self.cache_k, self.cache_v, self.d_last, self.d_lengths,
+            self.ec.decode_block, key,
+        )
+        self.cache_k, self.cache_v = out[0], out[1]
+        jax.device_get(out[2])
+        # Reset scheduling state dirtied by the dummy executions.
+        self.lengths[:] = 0
+        self.d_lengths = jnp.zeros(self.ec.max_slots, jnp.int32)
+        self.d_last = jnp.zeros(self.ec.max_slots, jnp.int32)
 
     # -- request lifecycle -------------------------------------------------
     def add_request(self, req_id: str, tokens, max_tokens: int = 64):
@@ -223,29 +292,47 @@ class LLMEngine:
         "finished": bool, "ttft_s": float|None, "tokens": [..] when done}}."""
         events: dict[str, dict] = {}
         retired = False
-        # 1. admit: dispatch a prefill per free slot WITHOUT fetching the
-        # sampled token (its device value feeds d_last directly; the host
-        # copy arrives with the block fetch below — one transfer per step).
-        prefilled: list[tuple[int, Any]] = []  # (slot_idx, tok_device)
+        # 1. admit: assign waiting requests to free slots, grouped by length
+        # bucket, one batched prefill program per group — no per-request
+        # sampled-token fetch (device values feed d_last directly; host
+        # copies arrive with the single block fetch below).
+        admitted: list[tuple[int, str, np.ndarray, int, int, float]] = []
         for i in range(self.ec.max_slots):
             if not self.waiting or self.slots[i] is not None:
                 continue
             req_id, tokens, max_tokens, arrived = self.waiting.popleft()
             P = len(tokens)
             bucket = next(b for b in self.buckets if b >= P)
-            padded = np.zeros(bucket, np.int32)
-            padded[:P] = tokens
-            self._key, sub = jax.random.split(self._key)
-            self.cache_k, self.cache_v, tok_dev = self._prefill(bucket)(
-                self.params, self.cache_k, self.cache_v,
-                jnp.asarray(padded), jnp.int32(P), jnp.int32(i), sub,
-            )
-            slot = _Slot(req_id=req_id, max_tokens=max_tokens, n_generated=1, arrived_at=arrived)
-            self.slots[i] = slot
-            self.lengths[i] = P
-            self.d_lengths = self.d_lengths.at[i].set(P)
-            self.d_last = self.d_last.at[i].set(tok_dev)
-            prefilled.append((i, tok_dev))
+            admitted.append((i, req_id, tokens, bucket, max_tokens, arrived))
+        prefilled: list[tuple[list[int], Any]] = []  # (slot_idxs, toks_device [k])
+        by_bucket: dict[int, list] = {}
+        for item in admitted:
+            by_bucket.setdefault(item[3], []).append(item)
+        for bucket, group in by_bucket.items():
+            while group:
+                k = next(kb for kb in self.k_buckets if kb <= len(group))
+                chunk, group = group[:k], group[k:]
+                idxs = [it[0] for it in chunk]
+                padded = np.zeros((k, bucket), np.int32)
+                lens = np.zeros(k, np.int32)
+                for j, (_i, _rid, tokens, _b, _mt, _arr) in enumerate(chunk):
+                    padded[j, : len(tokens)] = tokens
+                    lens[j] = len(tokens)
+                self._key, sub = jax.random.split(self._key)
+                self.cache_k, self.cache_v, toks_dev = self._prefill(bucket, k)(
+                    self.params, self.cache_k, self.cache_v,
+                    jnp.asarray(padded), jnp.asarray(lens),
+                    jnp.asarray(np.asarray(idxs, np.int32)), sub,
+                )
+                for (i, req_id, tokens, _b, max_tokens, arrived) in chunk:
+                    self.slots[i] = _Slot(
+                        req_id=req_id, max_tokens=max_tokens, n_generated=1, arrived_at=arrived
+                    )
+                    self.lengths[i] = len(tokens)
+                idx_arr = jnp.asarray(np.asarray(idxs, np.int32))
+                self.d_lengths = self.d_lengths.at[idx_arr].set(jnp.asarray(lens))
+                self.d_last = self.d_last.at[idx_arr].set(toks_dev)
+                prefilled.append((idxs, toks_dev))
         # 2. decode: one fused block over all slots
         active = [i for i, s in enumerate(self.slots) if s is not None]
         toks = None
@@ -255,7 +342,11 @@ class LLMEngine:
             positive = [r for r in remaining if r > 0]
             cap = self.ec.max_seq - 1 - int(max(self.lengths[i] for i in active))
             if positive and cap > 0:
-                n = int(max(1, min(self.ec.decode_block, min(positive), cap)))
+                # Full blocks only (overshoot past a slot's budget is
+                # discarded at absorb time): a tail-sized n would compile a
+                # fresh decode program per distinct value — seconds each on
+                # a cold cache, for a few tokens of saved compute.
+                n = int(max(1, min(self.ec.decode_block, cap)))
                 self._key, sub = jax.random.split(self._key)
                 (self.cache_k, self.cache_v, toks, self.d_last, self.d_lengths) = self._decode_jit(
                     self.params, self.cache_k, self.cache_v, self.d_last, self.d_lengths, n, sub,
@@ -266,18 +357,19 @@ class LLMEngine:
         fetch = jax.device_get(([t for _, t in prefilled], toks))
         prefill_toks, block_toks = fetch
         now = time.perf_counter()
-        for (i, _), tok in zip(prefilled, prefill_toks):
-            slot = self.slots[i]
-            tok = int(tok)
-            slot.first_token_at = now
-            slot.emitted.append(tok)
-            events[slot.req_id] = {
-                "token": tok,
-                "new_tokens": [tok],
-                "finished": False,
-                "ttft_s": now - slot.arrived_at,
-            }
-            retired |= self._maybe_finish(i, events)
+        for (idxs, _), group_toks in zip(prefilled, prefill_toks):
+            for i, tok in zip(idxs, np.asarray(group_toks).tolist()):
+                slot = self.slots[i]
+                tok = int(tok)
+                slot.first_token_at = now
+                slot.emitted.append(tok)
+                events[slot.req_id] = {
+                    "token": tok,
+                    "new_tokens": [tok],
+                    "finished": False,
+                    "ttft_s": now - slot.arrived_at,
+                }
+                retired |= self._maybe_finish(i, events)
         if block_toks is not None:
             block_toks = np.asarray(block_toks)  # [n, B]
             for step_i in range(n):
