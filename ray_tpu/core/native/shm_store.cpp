@@ -268,6 +268,13 @@ void* store_create(const char* path, uint64_t capacity) {
   return s;
 }
 
+uint8_t* store_base(void* sv) {
+  // Mapping base for offset arithmetic (offsets from create/get are
+  // file-absolute). Exported so out-of-tree users (the sanitizer stress
+  // harness) need not depend on Store's private layout.
+  return reinterpret_cast<Store*>(sv)->base;
+}
+
 void* store_attach(const char* path) {
   int fd = open(path, O_RDWR);
   if (fd < 0) return nullptr;

@@ -1521,8 +1521,19 @@ class CoreWorker:
         except Exception as e:
             # Uphold the ownership contract for errors outside the expected
             # set too (every spec handed here gets an outcome): otherwise the
-            # callers' reply futures never resolve.
+            # callers' reply futures never resolve. Drop the conn as well —
+            # it may hold partially-buffered frames for specs whose callers
+            # were just told they failed; reusing it would flush those frames
+            # and double-execute them.
             logger.exception("actor batch push failed (actor=%s)", actor_id.hex()[:8])
+            conn = entry.get("conn")
+            entry["conn"] = None
+            entry["addr"] = ""
+            if conn is not None:
+                try:
+                    await conn.close()
+                except Exception:
+                    pass
             for fut in [f for _, f in sent]:
                 fut.cancel()
             for spec in specs:

@@ -113,8 +113,12 @@ class Dataset:
                 first = _np.ascontiguousarray(next(iter(batch.values()))) if batch else _np.empty(0)
                 if first.dtype == object:
                     # Ragged columns: tobytes() would hash PyObject POINTERS
-                    # (different every run); hash the contents instead.
-                    ent = zlib.crc32(repr(first.tolist()).encode())
+                    # (different every run) and repr() truncates long
+                    # elements ('...'); pickle serializes full contents
+                    # deterministically for plain data.
+                    import pickle as _pkl
+
+                    ent = zlib.crc32(_pkl.dumps(first.tolist(), protocol=4))
                 else:
                     ent = zlib.crc32(first.tobytes())
                 rng = _np.random.default_rng([_seed, ent])

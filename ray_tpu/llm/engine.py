@@ -235,10 +235,10 @@ class LLMEngine:
         """Compile every (bucket, k) prefill program and the decode block
         before serving (the vLLM-style startup warmup): a cold compile costs
         seconds and would otherwise land inside the first loaded requests'
-        TTFT. Executes each program once with zero-length dummy requests into
-        slot 0 (cache contents are irrelevant while slot lengths stay 0)."""
-        import jax.numpy as jnp
-
+        TTFT. Executes each program once with dummy single-token requests
+        into slot 0; the device mirrors dirtied by those executions are reset
+        at the end (that reset is what makes the dummy state safe — cache
+        contents never matter for slots the scheduler considers empty)."""
         if buckets is None:
             buckets = self.buckets
         else:
@@ -271,8 +271,7 @@ class LLMEngine:
         )
         self.cache_k, self.cache_v = out[0], out[1]
         jax.device_get(out[2])
-        # Reset scheduling state dirtied by the dummy executions.
-        self.lengths[:] = 0
+        # Reset device mirrors dirtied by the dummy executions.
         self.d_lengths = jnp.zeros(self.ec.max_slots, jnp.int32)
         self.d_last = jnp.zeros(self.ec.max_slots, jnp.int32)
 
