@@ -339,6 +339,21 @@ class CoreWorker:
             reply = await self.daemon.call("register_worker", {"worker_id": self.worker_id, "address": self.address})
             self.node_id = reply["node_id"]
             self.config = Config.from_dict(reply["config"])
+
+            # Die with the parent daemon (reference:
+            # CoreWorker::ExitIfParentRayletDies, core_worker.h:1427): an
+            # orphan that outlives its node would otherwise idle forever,
+            # redialing a dead controller and holding memory.
+            def _daemon_lost(_conn):
+                if not self._shutdown:
+                    logger.warning("daemon connection lost; worker exiting")
+                    self._shutdown = True
+                    try:
+                        self.loop.call_soon(self.loop.stop)
+                    except Exception:
+                        pass
+
+            self.daemon.on_close = _daemon_lost
         set_ref_hooks(self._on_ref_created, self._on_ref_removed)
         self._bg.append(asyncio.create_task(self._reaper_loop()))
         if ready is not None:

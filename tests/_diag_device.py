@@ -1,0 +1,35 @@
+import time
+
+
+def test_diag2():
+    import ray_tpu as rt
+    import ray_tpu.core.worker as W
+
+    log = open("/tmp/diag.log", "w")
+    orig = W.CoreWorker.start_driver_sync
+
+    def patched(self):
+        try:
+            orig(self)
+        except TimeoutError:
+            import asyncio
+            import traceback
+
+            def dump():
+                import sys
+                for t in asyncio.all_tasks():
+                    print("== TASK:", t.get_name(), t.get_coro(), file=log, flush=True)
+                    t.print_stack(file=log)
+
+            self.loop.call_soon_threadsafe(dump)
+            time.sleep(3)
+            log.flush()
+            raise
+
+    W.CoreWorker.start_driver_sync = patched
+    try:
+        rt.init(num_cpus=2)
+        print("INIT-OK", file=log, flush=True)
+    finally:
+        W.CoreWorker.start_driver_sync = orig
+        rt.shutdown()
