@@ -104,6 +104,9 @@ class ObjectRefGenerator:
         # Consumption-ack hook for backpressured streams (set by the core
         # worker when the producer requests acks).
         self._ack = None
+        # Early-close hook (set at submit time): tells the producing worker
+        # to stop at its next yield (reference: CancelTask for streaming).
+        self._cancel = None
 
     # -- producer side (IO loop) --------------------------------------
     def reserve(self, index: int) -> bool:
@@ -166,7 +169,18 @@ class ObjectRefGenerator:
         with self._cond:
             return self._total is not None
 
+    def close(self):
+        """Stop consuming: best-effort cancellation of the producing task.
+        Idempotent; a no-op once the stream has finished."""
+        cb, self._cancel = self._cancel, None
+        if cb is not None and not self.completed():
+            try:
+                cb()
+            except Exception:
+                pass  # core already shut down: nothing to cancel
+
     def __del__(self):
+        self.close()
         # Unconsumed item refs drop their pins through ObjectRef.__del__.
         with self._cond:
             self._items.clear()

@@ -28,6 +28,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import concurrent.futures
+import functools
 import hashlib
 import inspect
 import logging
@@ -63,6 +64,11 @@ class ActorDiedError(Exception):
 
 class TaskCancelledError(Exception):
     pass
+
+
+class _StreamClosed(Exception):
+    """Internal: the consumer closed a streaming generator early; the
+    producer stops at its next yield."""
 
 
 @dataclass
@@ -184,6 +190,9 @@ class _KeySubmitter:
 
     async def _dispatch(self, w: LeasedWorker, items: list[tuple[TaskSpec, asyncio.Future]]):
         try:
+            for spec, _ in items:
+                if spec.num_returns == -1:
+                    self.core._stream_conns[spec.task_id.binary()] = w.conn
             reply = await w.conn.call("push_tasks", {"specs": [s for s, _ in items]})
             for (spec, fut), r in zip(items, reply["results"]):
                 self.core._absorb_task_reply(spec, r, fut)
@@ -281,6 +290,13 @@ class CoreWorker:
         self._streaming: dict[bytes, "ObjectRefGenerator"] = {}
         # Executor side: consumer-ack state per backpressured stream.
         self._gen_ack_state: dict[bytes, dict] = {}
+        # Caller side: the conn each live stream was pushed over, so a
+        # consumer close can reach the producing worker (reference:
+        # CoreWorkerService.CancelTask applied to streaming generators).
+        self._stream_conns: dict[bytes, Any] = {}
+        # Executor side: streams whose consumer closed early; the producer
+        # stops at its next yield.
+        self._cancelled_streams: set[bytes] = set()
         # Transient shm objects (dag zero-copy edges) whose delete was
         # deferred because a consumer view still pins them; reaped later.
         self._shm_garbage: list[ObjectID] = []
@@ -1079,6 +1095,8 @@ class CoreWorker:
             caller_addr=self.address,
         )
         gen = ObjectRefGenerator(task_id, self.address) if streaming else None
+        if gen is not None:
+            gen._cancel = functools.partial(self.cancel_stream, task_id.binary())
 
         # One loop hop, no blocking: registration + submission run as a single
         # FIFO callback, so they land before any subsequent get/free from this
@@ -1147,6 +1165,7 @@ class CoreWorker:
         deps = self._inflight_deps.pop(spec.task_id.binary(), None)
         self._event("task_finished", task_id=spec.task_id.hex(), status=reply.get("status"))
         if spec.num_returns == -1:  # streaming: items arrived via notifies
+            self._stream_conns.pop(spec.task_id.binary(), None)
             gen = self._streaming.pop(spec.task_id.binary(), None)
             if gen is not None:
                 if reply.get("status") == "error":
@@ -1253,9 +1272,13 @@ class CoreWorker:
                 )
             count = 0
             for value in out:
-                asyncio.run_coroutine_threadsafe(
-                    self._ship_generator_item(conn, spec, count, value), loop
-                ).result()
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        self._ship_generator_item(conn, spec, count, value), loop
+                    ).result()
+                except _StreamClosed:
+                    out.close()
+                    break
                 count += 1
             return count
 
@@ -1263,16 +1286,22 @@ class CoreWorker:
             return await loop.run_in_executor(self._executor, run)
         finally:
             self._gen_ack_state.pop(spec.task_id.binary(), None)
+            self._cancelled_streams.discard(spec.task_id.binary())
 
     async def _ship_generator_item(self, conn, spec: TaskSpec, index: int, value):
+        tid = spec.task_id.binary()
+        if tid in self._cancelled_streams:
+            raise _StreamClosed()
         bp = getattr(spec.options, "generator_backpressure", -1)
         if bp and bp > 0:
             st = self._gen_ack_state.setdefault(
-                spec.task_id.binary(), {"consumed": 0, "event": asyncio.Event()}
+                tid, {"consumed": 0, "event": asyncio.Event()}
             )
             while index - st["consumed"] >= bp:
                 st["event"].clear()
                 await st["event"].wait()
+                if tid in self._cancelled_streams:
+                    raise _StreamClosed()
         item = await self._package_value(ObjectID.for_return(spec.task_id, index), value)
         await conn.notify(
             "generator_item",
@@ -1290,6 +1319,29 @@ class CoreWorker:
         if st is not None and p["consumed"] > st["consumed"]:
             st["consumed"] = p["consumed"]
             st["event"].set()
+
+    def handle_generator_close(self, conn, p):
+        """Executor side: the consumer abandoned this stream. Mark it and
+        wake any backpressure-blocked producer so it observes the close."""
+        tid = p["task_id"]
+        self._cancelled_streams.add(tid)
+        st = self._gen_ack_state.get(tid)
+        if st is not None:
+            st["event"].set()
+
+    def cancel_stream(self, task_id_bytes: bytes):
+        """Caller side: best-effort early termination of a streaming task the
+        moment the consumer stops iterating (reference: CancelTask RPC for
+        streaming generators). Thread-safe; no-op once the stream finished."""
+
+        def go():
+            conn = self._stream_conns.get(task_id_bytes)
+            if conn is not None and not conn.closed:
+                asyncio.ensure_future(
+                    conn.notify("generator_close", {"task_id": task_id_bytes})
+                )
+
+        self.loop.call_soon_threadsafe(go)
 
     def _execute_task(self, fn, spec: TaskSpec):
         args, kwargs = serialization.deserialize(spec.args_blob)
@@ -1374,6 +1426,8 @@ class CoreWorker:
             ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(n_returns)
         ]
         gen = ObjectRefGenerator(task_id, self.address) if streaming else None
+        if gen is not None:
+            gen._cancel = functools.partial(self.cancel_stream, task_id.binary())
 
         def _go():
             if gen is not None:
@@ -1493,6 +1547,8 @@ class CoreWorker:
                     await self._refresh_actor_addr(actor_id, entry)
                 entry["conn"] = await self._peer_conn(entry["addr"])
             for spec in specs:
+                if spec.num_returns == -1:
+                    self._stream_conns[spec.task_id.binary()] = entry["conn"]
                 sent.append((spec, entry["conn"].call_start("push_actor_task", {"spec": spec})))
             # Backpressure: bound the transport buffer before the next drain.
             await entry["conn"].flush()
@@ -1768,9 +1824,18 @@ class ActorRuntime:
             args, kwargs = await loop.run_in_executor(None, self._resolve, spec.args_blob)
             count = 0
             async with sem:
-                async for value in method(*args, **kwargs):
-                    await self.core._ship_generator_item(conn, spec, count, value)
-                    count += 1
+                agen = method(*args, **kwargs)
+                try:
+                    async for value in agen:
+                        try:
+                            await self.core._ship_generator_item(conn, spec, count, value)
+                        except _StreamClosed:
+                            break
+                        count += 1
+                finally:
+                    await agen.aclose()
+                    self.core._gen_ack_state.pop(spec.task_id.binary(), None)
+                    self.core._cancelled_streams.discard(spec.task_id.binary())
             return count
 
         def run():
@@ -1782,13 +1847,21 @@ class ActorRuntime:
                 )
             n = 0
             for value in out:
-                asyncio.run_coroutine_threadsafe(
-                    self.core._ship_generator_item(conn, spec, n, value), loop
-                ).result()
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        self.core._ship_generator_item(conn, spec, n, value), loop
+                    ).result()
+                except _StreamClosed:
+                    out.close()
+                    break
                 n += 1
             return n
 
-        return await loop.run_in_executor(pool, run)
+        try:
+            return await loop.run_in_executor(pool, run)
+        finally:
+            self.core._gen_ack_state.pop(spec.task_id.binary(), None)
+            self.core._cancelled_streams.discard(spec.task_id.binary())
 
     def _resolve(self, blob):
         args, kwargs = serialization.deserialize(blob)

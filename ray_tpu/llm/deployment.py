@@ -1,15 +1,21 @@
 """LLM serving deployment: continuous-batching replica for ray_tpu.serve.
 
 Role-equivalent to the reference's LLMServer deployment
-(llm/_internal/serve/core/server/llm_server.py:99): a serve replica hosting
-one engine; concurrent generate() calls from the router land in the engine's
+(llm/_internal/serve/core/server/llm_server.py:99) plus its OpenAI-style SSE
+ingress (llm/_internal/serve/core/ingress/): a serve replica hosting one
+engine; concurrent generate() calls from the router land in the engine's
 waiting queue and are batched at iteration level by a background loop thread,
-so max_ongoing_requests concurrency maps directly onto engine slots.
+so max_ongoing_requests concurrency maps directly onto engine slots. Token
+streaming: generate_stream() yields per-decode-block events as they leave the
+device; through serve's streaming call path + the proxy's chunked writer a
+client sees the first token at engine TTFT, not at completion time.
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 
@@ -20,7 +26,8 @@ class LLMServer:
         app = serve.deployment(LLMServer).options(...).bind(cfg_kwargs, engine_kwargs)
     """
 
-    def __init__(self, model_config: dict, engine_config: Optional[dict] = None):
+    def __init__(self, model_config: dict, engine_config: Optional[dict] = None,
+                 warmup_buckets: Optional[tuple] = None):
         import jax
 
         from ray_tpu.llm.engine import EngineConfig, LLMEngine
@@ -29,9 +36,19 @@ class LLMServer:
         cfg = TransformerConfig(**model_config)
         ec = EngineConfig(**(engine_config or {}))
         self.engine = LLMEngine(cfg, engine_config=ec)
+        if warmup_buckets:
+            # Compile prefill/decode programs before the replica reports
+            # healthy (vLLM-style startup warmup): cold compiles belong to
+            # startup, never to a request's TTFT.
+            self.engine.warmup(buckets=tuple(warmup_buckets))
         self._cond = threading.Condition()
         self._done: dict[str, dict] = {}
         self._ttft: dict[str, float] = {}
+        # Per-request event streams for generate_stream subscribers.
+        self._streams: dict[str, deque] = {}
+        # Requests whose stream consumer disconnected; the loop thread aborts
+        # them in the engine (frees their slots) before its next step.
+        self._aborts: set[str] = set()
         self._counter = 0
         self._stop = False
         self._thread = threading.Thread(target=self._loop, name="llm-engine", daemon=True)
@@ -40,9 +57,14 @@ class LLMServer:
     def _loop(self):
         while not self._stop:
             with self._cond:
-                if not self.engine.has_work():
+                aborts, self._aborts = self._aborts, set()
+                if not aborts and not self.engine.has_work():
                     self._cond.wait(timeout=0.05)
                     continue
+            for rid in aborts:
+                self.engine.abort(rid)
+            if not self.engine.has_work():
+                continue
             events = self.engine.step()
             if not events:
                 continue
@@ -50,6 +72,9 @@ class LLMServer:
                 for rid, ev in events.items():
                     if ev.get("ttft_s") is not None:
                         self._ttft[rid] = ev["ttft_s"]
+                    stream = self._streams.get(rid)
+                    if stream is not None:
+                        stream.append(ev)
                     if ev.get("finished"):
                         self._done[rid] = {
                             "tokens": ev["tokens"],
@@ -57,12 +82,15 @@ class LLMServer:
                         }
                 self._cond.notify_all()
 
+    def _new_rid(self) -> str:
+        self._counter += 1
+        return f"r{self._counter}-{time.monotonic_ns()}"
+
     def generate(self, tokens, max_tokens: int = 64, timeout_s: float = 300.0) -> dict:
         """Blocking generate; safe to call from many router threads at once —
         the engine batches all in-flight requests per decode iteration."""
         with self._cond:
-            self._counter += 1
-            rid = f"r{self._counter}-{time.monotonic_ns()}"
+            rid = self._new_rid()
             self.engine.add_request(rid, tokens, max_tokens)
             self._cond.notify_all()
             deadline = time.time() + timeout_s
@@ -73,10 +101,69 @@ class LLMServer:
                 self._cond.wait(timeout=min(remaining, 1.0))
             return self._done.pop(rid)
 
-    def __call__(self, request: dict) -> dict:
-        return self.generate(
-            request["tokens"], int(request.get("max_tokens", 64))
-        )
+    def generate_stream(self, tokens, max_tokens: int = 64, timeout_s: float = 300.0):
+        """Streaming generate: yields one event dict per engine step that
+        produced tokens for this request ({"new_tokens": [...], "ttft_s":
+        float|None, "finished": bool}, final event carries "tokens"). Each
+        event leaves this replica the moment the decode block lands on host."""
+        with self._cond:
+            rid = self._new_rid()
+            self._streams[rid] = deque()
+            self.engine.add_request(rid, tokens, max_tokens)
+            self._cond.notify_all()
+        deadline = time.time() + timeout_s
+        finished = False
+        try:
+            while True:
+                with self._cond:
+                    while not self._streams[rid]:
+                        remaining = deadline - time.time()
+                        if remaining <= 0:
+                            raise TimeoutError(f"generate timed out after {timeout_s}s")
+                        self._cond.wait(timeout=min(remaining, 1.0))
+                    ev = self._streams[rid].popleft()
+                out = {
+                    "new_tokens": ev.get("new_tokens", []),
+                    "ttft_s": ev.get("ttft_s"),
+                    "finished": bool(ev.get("finished")),
+                }
+                if out["finished"]:
+                    out["tokens"] = ev.get("tokens", [])
+                    finished = True
+                yield out
+                if finished:
+                    return
+        finally:
+            with self._cond:
+                self._streams.pop(rid, None)
+                self._done.pop(rid, None)
+                if not finished:
+                    # Consumer left early (client disconnect): free the slot.
+                    self._aborts.add(rid)
+                    self._cond.notify_all()
+
+    def _sse_stream(self, tokens, max_tokens: int):
+        """OpenAI-style SSE frames (reference: llm ingress SSE): one
+        `data: {json}` frame per event, then `data: [DONE]`."""
+        for ev in self.generate_stream(tokens, max_tokens):
+            yield f"data: {json.dumps(ev)}\n\n"
+        yield "data: [DONE]\n\n"
+
+    def __call__(self, request):
+        """Accepts a serve HTTP Request (JSON body) or a plain dict:
+        {"tokens": [...], "max_tokens": N, "stream": bool}. With
+        stream=true returns a generator of SSE frames (the proxy sends it
+        chunked as text/event-stream); otherwise blocks and returns the
+        full completion."""
+        if hasattr(request, "json") and not isinstance(request, dict):
+            payload = request.json()
+        else:
+            payload = request
+        tokens = payload["tokens"]
+        max_tokens = int(payload.get("max_tokens", 64))
+        if payload.get("stream"):
+            return self._sse_stream(tokens, max_tokens)
+        return self.generate(tokens, max_tokens)
 
     def check_health(self) -> bool:
         return self._thread.is_alive()
@@ -90,7 +177,9 @@ class LLMServer:
 
 
 def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
-                  num_replicas: int = 1, max_ongoing_requests: Optional[int] = None):
+                  num_replicas: int = 1, max_ongoing_requests: Optional[int] = None,
+                  warmup_buckets: Optional[tuple] = None,
+                  ray_actor_options: Optional[dict] = None):
     """Build a serve application serving this model. max_ongoing_requests
     defaults to the engine's slot count (router admission == engine capacity)."""
     from ray_tpu import serve
@@ -101,5 +190,6 @@ def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
         name="llm",
         num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests or slots,
+        ray_actor_options=ray_actor_options or {},
     )
-    return dep.bind(model_config, engine_config)
+    return dep.bind(model_config, engine_config, warmup_buckets)

@@ -281,6 +281,18 @@ class LLMEngine:
             raise ValueError(f"prompt length {len(tokens)} >= max_seq {self.ec.max_seq}")
         self.waiting.append((req_id, np.asarray(tokens, np.int32), max_tokens, time.perf_counter()))
 
+    def abort(self, req_id: str) -> None:
+        """Drop a request whose consumer went away: dequeue it, or free its
+        slot so decode stops spending steps on it. Call from the stepping
+        thread only (mutates scheduler state + device mirrors)."""
+        self.waiting = deque(w for w in self.waiting if w[0] != req_id)
+        for i, s in enumerate(self.slots):
+            if s is not None and s.req_id == req_id:
+                self.slots[i] = None
+                self.lengths[i] = 0
+                self.d_lengths = jnp.asarray(self.lengths)
+                break
+
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
 

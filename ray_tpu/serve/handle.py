@@ -115,8 +115,9 @@ class _ReplicaSet:
                 self.cond.notify_all()
 
     # -- routing -----------------------------------------------------------
-    def route(self, method: str, args: tuple, kwargs: dict, timeout_s: float = 60.0):
-        """Pick a replica (pow-2 choices), submit, return (ref, name)."""
+    def _admit(self, timeout_s: float):
+        """Block until some replica has capacity; returns (name, handle) with
+        the ongoing count already incremented."""
         deadline = time.time() + timeout_s
         with self.cond:
             self.queued += 1
@@ -130,8 +131,7 @@ class _ReplicaSet:
                     name = self._pick_locked()
                     if name is not None:
                         self.ongoing[name] = self.ongoing.get(name, 0) + 1
-                        replica = self.replicas[name]
-                        break
+                        return name, self.replicas[name]
                     remaining = deadline - time.time()
                     if remaining <= 0:
                         raise TimeoutError(
@@ -144,18 +144,46 @@ class _ReplicaSet:
         finally:
             with self.cond:
                 self.queued -= 1
+
+    def _release(self, name: str):
+        with self.cond:
+            self.ongoing[name] = max(0, self.ongoing.get(name, 1) - 1)
+            self.cond.notify_all()
+
+    def route(self, method: str, args: tuple, kwargs: dict, timeout_s: float = 60.0):
+        """Pick a replica (pow-2 choices), submit, return (ref, name)."""
+        name, replica = self._admit(timeout_s)
         try:
             ref = replica.handle_request.remote(method, args, kwargs)
         except Exception:
+            self._release(name)
             with self.cond:
-                self.ongoing[name] = max(0, self.ongoing.get(name, 1) - 1)
                 self.fetched_at = 0.0
-                self.cond.notify_all()
             raise
         with self.cond:
             self._outstanding.append((ref, name))
             self._ensure_threads()
         return ref, name
+
+    def route_streaming(self, method: str, args: tuple, kwargs: dict,
+                        timeout_s: float = 60.0, proxy: bool = False):
+        """Streaming variant: returns (ObjectRefGenerator, name). The ongoing
+        count is held until the caller exhausts/closes the stream and calls
+        _release(name) (DeploymentResponseGenerator owns that)."""
+        name, replica = self._admit(timeout_s)
+        actor_method = (
+            replica.handle_request_proxy if proxy else replica.handle_request_streaming
+        )
+        try:
+            gen = actor_method.options(num_returns="streaming").remote(method, args, kwargs)
+        except Exception:
+            self._release(name)
+            with self.cond:
+                self.fetched_at = 0.0
+            raise
+        with self.cond:
+            self._ensure_threads()  # demand pusher must see streaming load too
+        return gen, name
 
     def _pick_locked(self) -> Optional[str]:
         live = [n for n in self.replicas if self.ongoing.get(n, 0) < self.max_ongoing]
@@ -267,29 +295,91 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment call's yielded items (reference:
+    handle.py DeploymentResponseGenerator over a streaming replica call).
+    Holds one unit of the replica's ongoing-request budget until the stream
+    is exhausted, errors, or is closed."""
+
+    def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict,
+                 proxy: bool = False):
+        self._rs = rs
+        self._released = False
+        self._gen, self._name = rs.route_streaming(method, args, kwargs, proxy=proxy)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu as rt
+        from ray_tpu.core.worker import ActorDiedError
+
+        try:
+            ref = next(self._gen)
+            return rt.get(ref, timeout=60)
+        except StopIteration:
+            self._release()
+            raise
+        except ActorDiedError:
+            # No mid-stream retry: items may already have been delivered.
+            self._rs.fail_over(self._name)
+            self._release()
+            raise
+        except BaseException:
+            self.close()  # producer may still be running: cancel it
+            raise
+
+    def _release(self):
+        if not self._released:
+            self._released = True
+            self._rs._release(self._name)
+
+    def close(self):
+        """Stop consuming: cancels the replica-side generator task (its next
+        yield observes the close and the user generator is closed), then
+        frees this stream's admission slot."""
+        self._gen.close()
+        self._release()
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:
+            pass
+
+
 class DeploymentHandle:
     """Picklable handle to a deployment (rebuilds router state lazily in the
     destination process, so it can be shipped as a bind() init arg)."""
 
-    def __init__(self, deployment_name: str, app_name: str = "default", method_name: str = "__call__"):
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__", stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
+        self.stream = stream
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, self.app_name, method_name)
+    def options(self, method_name: Optional[str] = None, stream: Optional[bool] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name,
+            self.app_name,
+            self.method_name if method_name is None else method_name,
+            self.stream if stream is None else stream,
+        )
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.deployment_name, self.app_name, name)
+        return DeploymentHandle(self.deployment_name, self.app_name, name, self.stream)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         rs = _replica_set(self.app_name, self.deployment_name)
+        if self.stream:
+            return DeploymentResponseGenerator(rs, self.method_name, args, kwargs)
         return DeploymentResponse(rs, self.method_name, args, kwargs)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.app_name, self.method_name))
+        return (DeploymentHandle, (self.deployment_name, self.app_name, self.method_name, self.stream))
 
     def __repr__(self):
         return f"DeploymentHandle({self.app_name}/{self.deployment_name}.{self.method_name})"

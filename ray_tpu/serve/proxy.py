@@ -40,6 +40,17 @@ class Request:
         return (Request, (self.method, self.path, self.query, self.headers, self.body))
 
 
+_STREAM_END = object()
+
+
+def _encode_chunk(chunk) -> bytes:
+    if isinstance(chunk, bytes):
+        return chunk
+    if isinstance(chunk, str):
+        return chunk.encode()
+    return json.dumps(chunk).encode() + b"\n"
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -107,15 +118,19 @@ class ProxyActor:
                 n = int(headers.get("content-length", 0) or 0)
                 if n:
                     body = await reader.readexactly(n)
-                status, payload, ctype = await self._loop.run_in_executor(
+                resp = await self._loop.run_in_executor(
                     self._pool, self._dispatch, method, target, headers, body
                 )
-                head = (
-                    f"HTTP/1.1 {status}\r\ncontent-type: {ctype}\r\n"
-                    f"content-length: {len(payload)}\r\nconnection: keep-alive\r\n\r\n"
-                )
-                writer.write(head.encode() + payload)
-                await writer.drain()
+                if len(resp) == 4:  # streaming: (status, chunk_iter, ctype, True)
+                    await self._write_streaming(writer, resp)
+                else:
+                    status, payload, ctype = resp
+                    head = (
+                        f"HTTP/1.1 {status}\r\ncontent-type: {ctype}\r\n"
+                        f"content-length: {len(payload)}\r\nconnection: keep-alive\r\n\r\n"
+                    )
+                    writer.write(head.encode() + payload)
+                    await writer.drain()
                 if headers.get("connection", "").lower() == "close":
                     break
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -127,6 +142,75 @@ class ProxyActor:
                 writer.close()
             except Exception:
                 pass
+
+    async def _write_streaming(self, writer: asyncio.StreamWriter, resp):
+        """Write an HTTP/1.1 chunked-transfer response, pulling each chunk
+        from the (blocking) stream iterator on the thread pool so the accept
+        loop never stalls (reference: proxy.py:710 ASGI streaming — first
+        byte reaches the client as soon as the replica yields it)."""
+        status, chunks, ctype, _ = resp
+        head = (
+            f"HTTP/1.1 {status}\r\ncontent-type: {ctype}\r\n"
+            f"transfer-encoding: chunked\r\nconnection: keep-alive\r\n\r\n"
+        )
+        writer.write(head.encode())
+        await writer.drain()
+        # One dedicated pump thread per stream (NOT the shared dispatch pool:
+        # a slow token stream blocks its puller for the stream's lifetime, and
+        # N concurrent streams on the shared pool would starve dispatch and
+        # health checks). Bounded queue gives the producer backpressure.
+        q: asyncio.Queue = asyncio.Queue(maxsize=8)
+        stop = threading.Event()
+        loop = self._loop
+
+        def put_blocking(item) -> bool:
+            """Blocking put that survives a departed writer: periodically
+            re-checks `stop` instead of waiting on the queue forever."""
+            while True:
+                fut = asyncio.run_coroutine_threadsafe(q.put(item), loop)
+                try:
+                    fut.result(timeout=1.0)
+                    return True
+                except concurrent.futures.TimeoutError:
+                    fut.cancel()
+                    if stop.is_set():
+                        return False
+                except Exception:
+                    return False
+
+        def pump():
+            try:
+                for chunk in chunks:
+                    if stop.is_set() or not put_blocking(chunk):
+                        break
+            except Exception:
+                pass  # mid-stream failure: terminate the chunked body
+            finally:
+                close = getattr(chunks, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+                put_blocking(_STREAM_END)
+
+        threading.Thread(target=pump, name="serve-stream-pump", daemon=True).start()
+        try:
+            while True:
+                chunk = await q.get()
+                if chunk is _STREAM_END:
+                    break
+                data = _encode_chunk(chunk)
+                if not data:
+                    continue
+                writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                await writer.drain()
+        finally:
+            stop.set()
+            while not q.empty():  # unblock a pump stuck on a full queue
+                q.get_nowait()
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
 
     # -- routing (runs on thread pool) -------------------------------------
     def _route_table(self) -> dict:
@@ -144,8 +228,6 @@ class ProxyActor:
         return self._routes
 
     def _dispatch(self, method: str, target: str, headers: dict, body: bytes):
-        from ray_tpu.serve.handle import DeploymentHandle
-
         parts = urlsplit(target)
         path = parts.path or "/"
         if path == "/-/healthz":
@@ -166,12 +248,51 @@ class ProxyActor:
         query = {k: v[0] if len(v) == 1 else v for k, v in parse_qs(parts.query).items()}
         req = Request(method, sub_path, query, headers, body)
         try:
-            result = DeploymentHandle(deployment, app).remote(req).result(timeout=60)
+            from ray_tpu.core.worker import ActorDiedError
+            from ray_tpu.serve.handle import DeploymentResponseGenerator, _replica_set
+
+            rs = _replica_set(app, deployment)
+            # Retry replica death only before the first item: nothing has
+            # reached the client yet, so re-routing is safe (mid-stream death
+            # is surfaced — items were already delivered).
+            for attempt in range(3):
+                gen = DeploymentResponseGenerator(rs, "__call__", (req,), {}, proxy=True)
+                try:
+                    tag, first = next(gen)
+                    break
+                except StopIteration:
+                    return "200 OK", b"", "text/plain"
+                except ActorDiedError:
+                    rs.fail_over("")
+                    if attempt == 2:
+                        raise
         except Exception as e:
             traceback.print_exc()
             return "500 Internal Server Error", json.dumps({"error": str(e)}).encode(), "application/json"
-        if isinstance(result, bytes):
-            return "200 OK", result, "application/octet-stream"
-        if isinstance(result, str):
-            return "200 OK", result.encode(), "text/plain"
-        return "200 OK", json.dumps(result).encode(), "application/json"
+        if tag == "value":
+            gen.close()
+            result = first
+            if isinstance(result, bytes):
+                return "200 OK", result, "application/octet-stream"
+            if isinstance(result, str):
+                return "200 OK", result.encode(), "text/plain"
+            return "200 OK", json.dumps(result).encode(), "application/json"
+        # Generator result: stream it (chunked). Content type from the first
+        # chunk's shape: SSE lines -> text/event-stream, str -> text/plain,
+        # bytes -> octet-stream, anything else -> newline-delimited JSON.
+        if isinstance(first, str):
+            ctype = "text/event-stream" if first.startswith("data:") else "text/plain"
+        elif isinstance(first, bytes):
+            ctype = "application/octet-stream"
+        else:
+            ctype = "application/x-ndjson"
+
+        def chunk_iter():
+            try:
+                yield first
+                for tag_i, item in gen:
+                    yield item
+            finally:
+                gen.close()
+
+        return "200 OK", chunk_iter(), ctype, True

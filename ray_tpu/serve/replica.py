@@ -47,20 +47,66 @@ class Replica:
             self.reconfigure(user_config)
 
     # -- data path ---------------------------------------------------------
+    def _resolve_fn(self, method: str):
+        if self._is_function:
+            if method not in ("__call__", ""):
+                raise AttributeError(
+                    f"function deployment {self.deployment_name} has no method {method!r}"
+                )
+            return self._instance
+        return getattr(self._instance, method or "__call__")
+
     def handle_request(self, method: str, args: tuple, kwargs: dict):
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
-            if self._is_function:
-                if method not in ("__call__", ""):
-                    raise AttributeError(
-                        f"function deployment {self.deployment_name} has no method {method!r}"
-                    )
-                fn = self._instance
+            return self._resolve_fn(method)(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def handle_request_streaming(self, method: str, args: tuple, kwargs: dict):
+        """Streaming call path: the user callable must return a generator;
+        each yielded item ships to the caller as its own streamed return
+        (reference: replica.py streaming generator user code riding
+        ReportGeneratorItemReturns). Invoked with num_returns='streaming'."""
+        import inspect
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            out = self._resolve_fn(method)(*args, **kwargs)
+            if not inspect.isgenerator(out) and not hasattr(out, "__next__"):
+                raise TypeError(
+                    f"deployment {self.deployment_name}.{method or '__call__'} was called "
+                    f"with stream=True but returned {type(out).__name__}, not a generator"
+                )
+            yield from out
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def handle_request_proxy(self, method: str, args: tuple, kwargs: dict):
+        """HTTP-proxy call path: always streamed on the wire, tagged so the
+        proxy can choose a buffered response for plain results and chunked
+        transfer for generator results without knowing the deployment's shape
+        up front. Yields ('value', x) once, or ('chunk', x) per item."""
+        import inspect
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            out = self._resolve_fn(method)(*args, **kwargs)
+            if inspect.isgenerator(out) or (
+                hasattr(out, "__next__") and not isinstance(out, (str, bytes))
+            ):
+                for item in out:
+                    yield ("chunk", item)
             else:
-                fn = getattr(self._instance, method or "__call__")
-            return fn(*args, **kwargs)
+                yield ("value", out)
         finally:
             with self._lock:
                 self._ongoing -= 1

@@ -141,3 +141,71 @@ def test_method_decorator_num_returns():
     r1, r2 = d.pair.remote()
     assert rt.get([r1, r2], timeout=60) == [1, 2]
     assert [rt.get(r, timeout=60) for r in d.stream.remote()] == ["a", "b"]
+
+
+def _count_lines(path):
+    try:
+        with open(path) as f:
+            return len(f.read().splitlines())
+    except FileNotFoundError:
+        return 0
+
+
+def test_stream_close_cancels_task_producer(tmp_path):
+    """gen.close() reaches the producing worker: the generator stops at its
+    next yield instead of running to completion (reference: CancelTask
+    applied to streaming generators)."""
+    import time
+
+    marker = str(tmp_path / "task_progress")
+
+    @rt.remote(num_returns="streaming")
+    def slow_stream(path, n):
+        for i in range(n):
+            with open(path, "a") as f:
+                f.write(f"{i}\n")
+            time.sleep(0.05)
+            yield i
+
+    gen = slow_stream.remote(marker, 200)
+    assert rt.get(next(gen), timeout=60) == 0
+    gen.close()
+    time.sleep(1.0)
+    settled = _count_lines(marker)
+    assert settled < 100, f"producer ran on after close ({settled} items)"
+    time.sleep(0.7)
+    assert _count_lines(marker) == settled, "producer still running after close"
+
+
+def test_stream_close_cancels_actor_producer(tmp_path):
+    import time
+
+    marker = str(tmp_path / "actor_progress")
+
+    @rt.remote
+    class Slow:
+        def stream(self, path, n):
+            for i in range(n):
+                with open(path, "a") as f:
+                    f.write(f"{i}\n")
+                time.sleep(0.05)
+                yield i
+
+    a = Slow.remote()
+    gen = a.stream.options(num_returns="streaming").remote(marker, 200)
+    assert rt.get(next(gen), timeout=60) == 0
+    gen.close()
+    time.sleep(1.0)
+    settled = _count_lines(marker)
+    assert settled < 100, f"producer ran on after close ({settled} items)"
+    time.sleep(0.7)
+    assert _count_lines(marker) == settled, "producer still running after close"
+    # The actor itself stays healthy and serves new calls.
+    gen2 = a.stream.options(num_returns="streaming").remote(str(tmp_path / "p2"), 3)
+    assert [rt.get(r, timeout=60) for r in gen2] == [0, 1, 2]
+
+
+def test_stream_close_after_exhaustion_is_noop():
+    gen = count_to.remote(3)
+    assert [rt.get(r, timeout=60) for r in gen] == [0, 10, 20]
+    gen.close()  # finished stream: nothing to cancel
