@@ -67,7 +67,9 @@ class ActorMethod:
         from ray_tpu.core import api
 
         core = api._require_worker()
-        opts = replace(self._handle._opts)
+        # Stable options identity (no per-call copy): the wire layer interns
+        # it per connection so repeat calls ship lean frames.
+        opts = self._handle._opts
         refs = core.submit_actor_task_sync(
             self._handle._actor_id, self._name, args, kwargs, self._num_returns, opts,
             concurrency_group=self._concurrency_group,

@@ -12,6 +12,8 @@ from __future__ import annotations
 import asyncio
 import atexit
 import inspect
+import os
+import tempfile
 import threading
 import time
 from typing import Any, Optional, Sequence
@@ -60,23 +62,58 @@ class _ServiceHost:
         self.thread.join(timeout=5)
 
 
+def _session_token_path(address: str) -> str:
+    """Where the head publishes this session's auto-generated RPC token
+    (mode 0600): same-host clients joining by address load it from here."""
+    port = address.rsplit(":", 1)[-1]
+    return os.path.join(tempfile.gettempdir(), f"raytpu_token_{port}")
+
+
 class Cluster:
     """Multi-node cluster on one machine (reference: cluster_utils.Cluster)."""
 
     def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None,
                  config: Config | None = None, persist_path: str | None = None):
         self.config = config or get_config()
+        if not self.config.auth_token and os.environ.get("RAYTPU_AUTO_TOKEN", "1") != "0":
+            # Auto-generated per-session RPC secret (reference: required auth
+            # infrastructure, src/ray/rpc/authentication): the head mints a
+            # token at cluster start, propagates it to daemons (in-process),
+            # workers (env), and same-host drivers (session token file, see
+            # _session_token_path). Pickle-over-TCP is never unauthenticated
+            # by default; set RAYTPU_AUTO_TOKEN=0 to opt out, or
+            # RAYTPU_AUTH_TOKEN to pin a cluster-wide token for multi-host.
+            import secrets
+
+            self.config.auth_token = secrets.token_hex(16)
         if self.config.auth_token:
-            # Opt-in per-session RPC secret (see rpc.py auth): set
-            # Config.auth_token (or RAYTPU_AUTH_TOKEN) before cluster start;
-            # it propagates to daemons (in-process), workers (env) and
-            # external drivers (config/env).
             from ray_tpu.core import rpc as _rpc
 
             _rpc.set_auth_token(self.config.auth_token)
         self.host = _ServiceHost()
         self.controller = Controller(self.config, persist_path=persist_path)
         self.controller_addr = self.host.call(self.controller.start())
+        self._token_file = None
+        if self.config.auth_token:
+            # O_EXCL|O_NOFOLLOW after unlink: an attacker-planted file or
+            # symlink at the predictable path must never receive the secret
+            # (O_CREAT|O_TRUNC would happily write into it with ITS mode).
+            path = _session_token_path(self.controller_addr)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            try:
+                fd = os.open(
+                    path,
+                    os.O_WRONLY | os.O_CREAT | os.O_EXCL | getattr(os, "O_NOFOLLOW", 0),
+                    0o600,
+                )
+                with os.fdopen(fd, "w") as f:
+                    f.write(self.config.auth_token)
+                self._token_file = path
+            except OSError:
+                pass  # couldn't publish safely: joiners must use RAYTPU_AUTH_TOKEN
         self.daemons: list[NodeDaemon] = []
         if initialize_head:
             self.add_node(**(head_node_args or {}))
@@ -142,6 +179,12 @@ class Cluster:
         except Exception:
             pass
         self.host.stop()
+        if self._token_file:
+            try:
+                os.unlink(self._token_file)
+            except OSError:
+                pass
+            self._token_file = None
 
 
 def init(
@@ -158,6 +201,25 @@ def init(
     if _global_worker is not None:
         return {"address": _global_worker.controller_addr}
     cfg = config or get_config()
+    if not cfg.auth_token and address is not None:
+        # Same-host driver joining an auto-tokened cluster: pick the session
+        # token up from the head's token file (multi-host joins pass
+        # RAYTPU_AUTH_TOKEN explicitly). Trust the file ONLY if it is ours
+        # and private — an attacker-planted token would let them MITM the
+        # session (we'd authenticate to their endpoint).
+        try:
+            fd = os.open(
+                _session_token_path(address),
+                os.O_RDONLY | getattr(os, "O_NOFOLLOW", 0),
+            )
+            try:
+                st = os.fstat(fd)
+                if st.st_uid == os.getuid() and not (st.st_mode & 0o077):
+                    cfg.auth_token = os.read(fd, 256).decode().strip()
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
     if cfg.auth_token:  # external driver joining an authed cluster
         from ray_tpu.core import rpc as _rpc
 

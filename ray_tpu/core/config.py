@@ -65,7 +65,10 @@ class Config:
     # for static clusters.
     infeasible_as_pending: bool = False
     # --- actors ---
-    actor_creation_timeout_s: float = 60.0
+    # Generous: an actor __init__ may compile models (LLM replica warmup on
+    # TPU takes minutes); the daemon is async, so a slow construct doesn't
+    # block its other RPCs.
+    actor_creation_timeout_s: float = 600.0
     max_actor_restarts_default: int = 0
     # --- failure handling ---
     task_retry_delay_s: float = 0.05

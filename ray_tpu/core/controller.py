@@ -45,6 +45,10 @@ class NodeRecord:
     conn: Any = None
     last_heartbeat: float = 0.0
     state: str = "ALIVE"
+    # Drain protocol (reference: NodeManagerService.DrainRaylet): a draining
+    # node accepts no NEW leases/actors/bundles but keeps serving running
+    # work and object reads until the drainer terminates it.
+    draining: bool = False
 
 
 @dataclass
@@ -422,9 +426,34 @@ class Controller:
                 "labels": n.labels,
                 "store_path": n.store_path,
                 "state": n.state,
+                "draining": n.draining,
             }
             for nid, n in self.nodes.items()
         }
+
+    def handle_drain_node(self, conn, p):
+        """Start draining: no new leases/actors/bundles schedule onto the
+        node; running work and object reads continue (reference:
+        NodeManagerService.DrainRaylet). Idempotent; returns whether the
+        node is currently free of running leases/actors (safe to terminate)."""
+        node = self.nodes.get(p["node_id"])
+        if node is None or node.state != "ALIVE":
+            return {"ok": False, "reason": "no such live node"}
+        node.draining = True
+        self._event("node_draining", node_id=p["node_id"])
+        idle = all(
+            abs(node.resources_available.get(k, 0) - v) < 1e-6
+            for k, v in node.resources_total.items()
+        )
+        return {"ok": True, "idle": idle}
+
+    def handle_undrain_node(self, conn, p):
+        node = self.nodes.get(p["node_id"])
+        if node is not None:
+            node.draining = False
+            # Reopened capacity: demand that pended against the drain runs now.
+            asyncio.create_task(self._retry_pending())
+        return {"ok": node is not None}
 
     def handle_heartbeat(self, conn, p):
         node = self.nodes.get(p["node_id"])
@@ -645,11 +674,16 @@ class Controller:
         return [k for k in self.kv.get(p.get("ns", ""), {}) if k.startswith(prefix)]
 
     # -- scheduling core ------------------------------------------------
-    def _feasible_nodes(self, demand: dict, label_selector: dict) -> list[NodeRecord]:
+    def _feasible_nodes(self, demand: dict, label_selector: dict,
+                        include_draining: bool = False) -> list[NodeRecord]:
+        # include_draining: infeasibility checks count draining capacity —
+        # demand a draining node COULD serve must pend (drain may be
+        # cancelled), not hard-fail as never-satisfiable.
         return [
             n
             for n in self.nodes.values()
             if n.state == "ALIVE"
+            and (include_draining or not n.draining)
             and _labels_match(n.labels, label_selector)
             and all(n.resources_total.get(k, 0) + 1e-9 >= v for k, v in demand.items())
         ]
@@ -673,7 +707,7 @@ class Controller:
             return None
         if kind == "NODE_AFFINITY":
             node = self.nodes.get(strategy.node_id)
-            if node and node.state == "ALIVE" and _fits(node.resources_available, demand):
+            if node and node.state == "ALIVE" and not node.draining and _fits(node.resources_available, demand):
                 return node
             if getattr(strategy, "soft", False):
                 pass  # fall through to default policy
@@ -700,7 +734,11 @@ class Controller:
         return min(feasible, key=utilization)  # spread: least utilized
 
     def _consume(self, node: NodeRecord, demand: dict, strategy=None):
-        _sub(node.resources_available, demand)
+        # PG-bound demand consumes its BUNDLE only: the bundle's reservation
+        # was already subtracted from the node when the PG was committed
+        # (reference: PG actors use the group's reservation, they don't stack
+        # on top of it). Subtracting from the node again here would corrupt
+        # the cluster availability view (double-count).
         if strategy is not None and getattr(strategy, "kind", "") == "PLACEMENT_GROUP":
             pg = self.pgs.get(strategy.placement_group)
             if pg:
@@ -710,11 +748,10 @@ class Controller:
                     if b.node_id == node.node_id and _fits(b.available, demand):
                         _sub(b.available, demand)
                         break
+            return
+        _sub(node.resources_available, demand)
 
     def _restore(self, node_id: str, demand: dict, strategy=None):
-        node = self.nodes.get(node_id)
-        if node and node.state == "ALIVE":
-            _add(node.resources_available, demand)
         if strategy is not None and getattr(strategy, "kind", "") == "PLACEMENT_GROUP":
             pg = self.pgs.get(strategy.placement_group)
             if pg:
@@ -724,6 +761,10 @@ class Controller:
                     if b.node_id == node_id:
                         _add(b.available, demand)
                         break
+            return
+        node = self.nodes.get(node_id)
+        if node and node.state == "ALIVE":
+            _add(node.resources_available, demand)
 
     async def handle_request_lease(self, conn, p):
         """Grant a worker lease: returns node address once resources free up.
@@ -741,7 +782,7 @@ class Controller:
             return {"node_id": node.node_id, "address": node.address, "store_path": node.store_path, "strategy": strategy}
         if (
             not self.config.infeasible_as_pending
-            and not self._feasible_nodes(demand, p.get("label_selector", {}))
+            and not self._feasible_nodes(demand, p.get("label_selector", {}), include_draining=True)
             and getattr(strategy, "kind", "") != "PLACEMENT_GROUP"
         ):
             return {"infeasible": True}
@@ -1059,7 +1100,7 @@ class Controller:
         pg.pending_waiters.clear()
 
     def _plan_bundles(self, pg: PGRecord) -> Optional[list]:
-        nodes = [n for n in self.nodes.values() if n.state == "ALIVE"]
+        nodes = [n for n in self.nodes.values() if n.state == "ALIVE" and not n.draining]
         if pg.label_selector:
             nodes = [n for n in nodes if _labels_match(n.labels, pg.label_selector)]
         nodes.sort(key=lambda n: n.node_id)

@@ -7,8 +7,27 @@ embed their owner's job for debuggability but are otherwise opaque.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import threading
+
+# Process-local entropy for the HOT id kinds only (TaskID, put ObjectID —
+# minted per call on the submission path): one urandom draw per process,
+# then a counter (reference does the same: ids are derived, not drawn —
+# id.h TaskID::ForNormalTask composes parent id + counter). Rare id kinds
+# (Node/Worker/Actor/PG) stay fully random: code may key resources on a
+# TRUNCATED id (e.g. the node arena path uses node_id[:12]), and a shared
+# per-process prefix would collide those truncations.
+_pid = 0
+_prefix = b""
+_counter = itertools.count()
+
+
+def _fresh_entropy():
+    global _pid, _prefix, _counter
+    _pid = os.getpid()
+    _prefix = os.urandom(24)
+    _counter = itertools.count(int.from_bytes(os.urandom(8), "little"))
 
 _KIND_SIZES = {
     "JobID": 4,
@@ -95,6 +114,15 @@ class ActorID(BaseID):
 class TaskID(BaseID):
     SIZE = 16
 
+    @classmethod
+    def from_random(cls):
+        # Hot path (every task submission). 8-byte process prefix + counter;
+        # truncated TaskID uses are logging-only, so the shared prefix is safe.
+        if os.getpid() != _pid:
+            _fresh_entropy()
+        n = next(_counter) & 0xFFFFFFFFFFFFFFFF
+        return cls(_prefix[:8] + n.to_bytes(8, "little"))
+
 
 class PlacementGroupID(BaseID):
     SIZE = 12
@@ -111,7 +139,10 @@ class ObjectID(BaseID):
 
     @classmethod
     def from_put(cls):
-        return cls(os.urandom(16) + (2**32 - 1).to_bytes(4, "little"))
+        if os.getpid() != _pid:
+            _fresh_entropy()
+        n = next(_counter) & 0xFFFFFFFFFFFFFFFF
+        return cls(_prefix[:8] + n.to_bytes(8, "little") + (2**32 - 1).to_bytes(4, "little"))
 
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[:16])

@@ -209,7 +209,7 @@ class NodeDaemon:
         """(env overrides, extra sys.path entries, cwd, hash) for a runtime
         env spec; packages cached per URI under the session dir."""
         if not renv:
-            return {}, [], None, ""
+            return {}, [], None, "", None
         from ray_tpu.core import runtime_env as _re
 
         async def kv_get(uri: str):
@@ -217,11 +217,12 @@ class NodeDaemon:
 
         cache_root = os.path.join(self.session_dir, "runtime_envs")
         os.makedirs(cache_root, exist_ok=True)
-        env_vars, pypath, cwd = await _re.materialize(renv, cache_root, kv_get)
-        return env_vars, pypath, cwd, renv.get("hash", "")
+        env_vars, pypath, cwd, python_exe = await _re.materialize(renv, cache_root, kv_get)
+        return env_vars, pypath, cwd, renv.get("hash", ""), python_exe
 
     def _spawn_worker(self, env_overrides: dict | None = None, pypath: list | None = None,
-                      cwd: str | None = None, env_hash: str = "") -> WorkerRecord:
+                      cwd: str | None = None, env_hash: str = "",
+                      python_exe: str | None = None) -> WorkerRecord:
         worker_id = WorkerID.from_random().hex()
         env = {**os.environ, **self._spawn_env, **(env_overrides or {})}
         env["RAYTPU_WORKER_ID"] = worker_id
@@ -252,7 +253,9 @@ class NodeDaemon:
             stderr = open(os.path.join(self.log_dir, f"worker-{worker_id}.err"), "ab")
             env.setdefault("PYTHONUNBUFFERED", "1")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            # python_exe: a runtime-env venv's interpreter (pip isolation);
+            # defaults to the daemon's own.
+            [python_exe or sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
             cwd=cwd,
             stdout=stdout,
@@ -308,13 +311,13 @@ class NodeDaemon:
             pass
 
     async def _acquire_worker(self, renv: Optional[dict] = None) -> WorkerRecord:
-        env_vars, pypath, cwd, env_hash = await self._materialize_env(renv)
+        env_vars, pypath, cwd, env_hash, python_exe = await self._materialize_env(renv)
         pool = self.idle_workers.get(env_hash, [])
         while pool:
             w = pool.pop()
             if w.state == "IDLE" and w.conn and not w.conn.closed:
                 return w
-        record = self._spawn_worker(env_vars, pypath, cwd, env_hash)
+        record = self._spawn_worker(env_vars, pypath, cwd, env_hash, python_exe)
         await asyncio.wait_for(record.ready, timeout=self.config.worker_start_timeout_s)
         return record
 

@@ -158,19 +158,38 @@ class SharedMemoryClient:
         """create(), spilling (if a spill dir exists) or evicting LRU objects
         as needed. Returns (buffer, evicted ids) — truly-evicted objects must
         be reported to the object directory; spilled ones stay available on
-        this node and are NOT reported."""
+        this node and are NOT reported.
+
+        Frees PROGRESSIVELY: a first-fit arena fragments, so "total free >=
+        size" does not imply a fitting hole (the create can fail with space
+        nominally available). Each round asks for `extra` bytes BEYOND what
+        is currently free (spill preferred, then eviction) and doubles
+        `extra` until the create lands or nothing freeable remains —
+        the reference's plasma create-request queue retries after eviction
+        the same way (CreateRequestQueue + fallback allocation)."""
         try:
             return self.create(oid, size), []
         except ObjectStoreFullError:
-            need = size + (size >> 3)
-            spilled = self.spill(need)
-            if spilled:
-                try:
-                    return self.create(oid, size), []
-                except ObjectStoreFullError:
-                    pass
-            evicted = self.evict(need)
-            return self.create(oid, size), evicted
+            pass
+        evicted: list[ObjectID] = []
+        extra = size + (size >> 3)
+        while True:
+            # Target = current-available + extra: forces the victim scan past
+            # its "already enough available" early-out (fragmented free space
+            # is counted available but may fit nothing).
+            target = (self.capacity - self.used) + extra
+            spilled = self.spill(target)
+            freed_any = bool(spilled)
+            if not spilled:
+                ev = self.evict(target)
+                evicted.extend(ev)
+                freed_any = bool(ev)
+            try:
+                return self.create(oid, size), evicted
+            except ObjectStoreFullError:
+                if not freed_any:
+                    raise  # nothing left to free (all pinned): genuine OOM
+                extra *= 2
 
     # -- spilling -------------------------------------------------------
     def spill(self, nbytes: int, max_ids: int = 4096) -> list[ObjectID]:

@@ -31,11 +31,22 @@ class RemoteFunction:
         if self._fn_id is None or getattr(self, "_fn_session", None) is not core:
             self._fn_id = core.export_callable("fn", self._fn)
             self._fn_session = core
-        opts = replace(self._opts)
+        # Reuse the handle's options object (submit treats it as immutable):
+        # a stable identity lets the wire layer intern it per connection and
+        # ship lean per-call frames. Runtime-env packaging is cached on the
+        # handle for the same reason — a fresh options object per call would
+        # grow the intern maps unboundedly and defeat the lean frames.
+        opts = self._opts
         if opts.runtime_env:
-            from ray_tpu.core.runtime_env import package_runtime_env
+            packaged = getattr(self, "_packaged_opts", None)
+            if packaged is None or getattr(self, "_pkg_session", None) is not core:
+                from ray_tpu.core.runtime_env import package_runtime_env
 
-            opts.runtime_env = package_runtime_env(core, opts.runtime_env)
+                packaged = replace(opts)
+                packaged.runtime_env = package_runtime_env(core, opts.runtime_env)
+                self._packaged_opts = packaged
+                self._pkg_session = core
+            opts = packaged
         refs = core.submit_task_sync(self._fn_id, args, kwargs, opts)
         if self._opts.num_returns == "streaming":
             return refs  # an ObjectRefGenerator

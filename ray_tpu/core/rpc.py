@@ -68,6 +68,22 @@ def _tag(payload: bytes) -> bytes:
     return hmac.new(_frame_key, payload, hashlib.sha256).digest()[:_TAG_LEN]
 
 
+def frame_tag(payload: bytes) -> bytes:
+    """Public tag helper for auxiliary authenticated protocols (e.g. the
+    serve proxy's binary ingress): HMAC(session key, payload) prefix, or
+    b"" when auth is disabled. Verify with frame_verify."""
+    return _tag(payload) if _frame_key else b""
+
+
+def frame_verify(tag: bytes, payload: bytes) -> bool:
+    if not _frame_key:
+        return True  # auth disabled for this session
+    return len(tag) == _TAG_LEN and hmac.compare_digest(tag, _tag(payload))
+
+
+FRAME_TAG_LEN = _TAG_LEN
+
+
 class RpcError(Exception):
     pass
 

@@ -13,7 +13,12 @@ a different environment.
 
 Supported keys: ``env_vars`` (dict), ``working_dir`` (local dir, shipped and
 made the worker's cwd + sys.path entry), ``py_modules`` (list of local dirs
-added to sys.path).
+added to sys.path), ``pip`` (list of requirement strings — the daemon builds
+a cached ``--system-site-packages`` venv keyed by the requirement set and
+spawns the worker from that venv's interpreter, so two jobs with conflicting
+dependency versions coexist on one cluster; reference:
+_private/runtime_env/pip.py + uri_cache.py), ``pip_install_options`` (extra
+pip args, e.g. ``--no-index`` for air-gapped local-path installs).
 """
 from __future__ import annotations
 
@@ -62,7 +67,7 @@ def package_runtime_env(core, renv: dict) -> dict:
     content hash — the reference's URI cache), env_vars pass through."""
     if renv.get("_resolved"):
         return renv  # already packaged (e.g. reused from another task's options)
-    known = {"env_vars", "working_dir", "py_modules"}
+    known = {"env_vars", "working_dir", "py_modules", "pip", "pip_install_options"}
     unknown = set(renv) - known
     if unknown:
         raise ValueError(f"unsupported runtime_env keys {sorted(unknown)}; supported: {sorted(known)}")
@@ -92,42 +97,156 @@ def package_runtime_env(core, renv: dict) -> dict:
     for mod in renv.get("py_modules", []):
         pkgs.append({"uri": upload(mod), "kind": "py_module"})
     spec["pkgs"] = pkgs
+    if renv.get("pip"):
+        reqs = renv["pip"]
+        if isinstance(reqs, dict):
+            reqs = reqs.get("packages", [])
+        # Local-path requirements become content-addressed packages too: the
+        # venv key must change when the source changes, and remote daemons
+        # need the bits (the reference ships working-dir-relative pips the
+        # same way).
+        resolved = []
+        for r in reqs:
+            if os.path.isdir(r):
+                resolved.append({"uri": upload(r), "kind": "pip_local"})
+            else:
+                resolved.append({"req": str(r)})
+        spec["pip"] = resolved
+        spec["pip_install_options"] = list(renv.get("pip_install_options", []))
     spec["hash"] = hashlib.sha1(
-        json.dumps({k: spec[k] for k in ("env_vars", "pkgs")}, sort_keys=True).encode()
+        json.dumps(
+            {k: spec.get(k) for k in ("env_vars", "pkgs", "pip", "pip_install_options")},
+            sort_keys=True,
+        ).encode()
     ).hexdigest()[:16]
     return spec
 
 
-async def materialize(spec: dict, cache_root: str, kv_get) -> tuple[dict, list, str | None]:
-    """Daemon-side: download/extract packages (cached per URI), return
-    (env_vars, extra sys.path entries, cwd or None). ``kv_get`` is an async
+async def _fetch_pkg(uri: str, cache_root: str, kv_get) -> str:
+    """Download/extract one content-addressed package (cached per URI);
+    returns the extracted directory."""
+    import asyncio
+
+    dest = os.path.join(cache_root, uri)
+    if not os.path.isdir(dest):
+        data = await kv_get(uri)
+        if data is None:
+            raise RuntimeError(f"runtime_env package {uri} missing from the cluster KV")
+
+        def extract():  # off the event loop: large zips must not stall the daemon
+            tmp = f"{dest}.tmp{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(data)) as z:
+                z.extractall(tmp)
+            try:
+                os.rename(tmp, dest)
+            except OSError:  # concurrent materialization won the race
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+
+        await asyncio.get_running_loop().run_in_executor(None, extract)
+    return dest
+
+
+# Per-venv-key build locks: concurrent leases on one event loop (e.g. the
+# in-process test cluster's daemons) build each venv exactly once
+# (reference: pip.py builds under a per-URI lock). Cross-process safety
+# comes from unique tmp dirs + the atomic rename.
+_venv_locks: dict[str, Any] = {}
+
+
+async def _build_venv(spec: dict, cache_root: str, kv_get) -> str:
+    """Build (or reuse) the venv for a pip spec; returns its python
+    executable. Content-hash keyed on the resolved requirement set, built
+    atomically (unique tmp dir + rename) so concurrent leases share one
+    build (reference: pip.py + uri_cache.py reuse)."""
+    import asyncio
+    import subprocess
+    import sys
+    import threading
+
+    install_args: list[str] = []
+    key_parts: list[str] = list(spec.get("pip_install_options", []))
+    for item in spec["pip"]:
+        if "uri" in item:  # local package shipped through the KV
+            pkg_dir = await _fetch_pkg(item["uri"], cache_root, kv_get)
+            install_args.append(pkg_dir)
+            key_parts.append(item["uri"])
+        else:
+            install_args.append(item["req"])
+            key_parts.append(item["req"])
+    key = hashlib.sha1(json.dumps(sorted(key_parts)).encode()).hexdigest()[:16]
+    venv_dir = os.path.join(cache_root, "venvs", key)
+    py = os.path.join(venv_dir, "bin", "python")
+    if os.path.exists(py):
+        return py  # cache hit
+
+    import asyncio as _aio
+
+    lock = _venv_locks.setdefault(f"{cache_root}:{key}", _aio.Lock())
+
+    def build():
+        import glob as _glob
+
+        tmp = f"{venv_dir}.tmp{os.getpid()}_{threading.get_ident()}"
+        # --system-site-packages: the job environment LAYERS over the base
+        # interpreter (jax and friends stay importable); only the requested
+        # packages are isolated per env.
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages", tmp],
+            check=True, capture_output=True,
+        )
+        # When THIS interpreter is itself a venv, --system-site-packages
+        # points at the base python's site-packages, skipping the parent
+        # env's (the standard venv-from-venv gap). A .pth appends the
+        # parent's site dirs AFTER the new venv's own, so the job's pinned
+        # packages still win over the parent's copies.
+        parent_sites = [p for p in sys.path if p.rstrip("/").endswith("site-packages")]
+        if parent_sites:
+            for site_dir in _glob.glob(os.path.join(tmp, "lib", "python*", "site-packages")):
+                with open(os.path.join(site_dir, "_raytpu_parent_env.pth"), "w") as f:
+                    f.write("\n".join(parent_sites) + "\n")
+        cmd = [os.path.join(tmp, "bin", "python"), "-m", "pip", "install",
+               "--disable-pip-version-check", "--no-input"]
+        cmd += spec.get("pip_install_options", [])
+        cmd += install_args
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"pip install failed for runtime_env {spec.get('hash')}:\n{proc.stderr[-2000:]}"
+            )
+        os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+        try:
+            os.rename(tmp, venv_dir)
+        except OSError:  # concurrent build won
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    async with lock:
+        if not os.path.exists(py):  # re-check: another lease built it
+            await asyncio.get_running_loop().run_in_executor(None, build)
+    return py
+
+
+async def materialize(spec: dict, cache_root: str, kv_get) -> tuple[dict, list, str | None, str | None]:
+    """Daemon-side: download/extract packages (cached per URI) and build the
+    pip venv if requested. Returns (env_vars, extra sys.path entries,
+    cwd or None, python executable or None). ``kv_get`` is an async
     callable uri -> bytes."""
     env_vars = dict(spec.get("env_vars", {}))
     pypath: list[str] = []
     cwd = None
     for pkg in spec.get("pkgs", []):
-        dest = os.path.join(cache_root, pkg["uri"])
-        if not os.path.isdir(dest):
-            data = await kv_get(pkg["uri"])
-            if data is None:
-                raise RuntimeError(f"runtime_env package {pkg['uri']} missing from the cluster KV")
-
-            def extract():  # off the event loop: large zips must not stall the daemon
-                tmp = f"{dest}.tmp{os.getpid()}"
-                os.makedirs(tmp, exist_ok=True)
-                with zipfile.ZipFile(io.BytesIO(data)) as z:
-                    z.extractall(tmp)
-                try:
-                    os.rename(tmp, dest)
-                except OSError:  # concurrent materialization won the race
-                    import shutil
-
-                    shutil.rmtree(tmp, ignore_errors=True)
-
-            import asyncio
-
-            await asyncio.get_running_loop().run_in_executor(None, extract)
+        dest = await _fetch_pkg(pkg["uri"], cache_root, kv_get)
         pypath.append(dest)
         if pkg["kind"] == "working_dir":
             cwd = dest
-    return env_vars, pypath, cwd
+    python_exe = None
+    if spec.get("pip"):
+        python_exe = await _build_venv(spec, cache_root, kv_get)
+    return env_vars, pypath, cwd, python_exe

@@ -190,10 +190,28 @@ class _KeySubmitter:
 
     async def _dispatch(self, w: LeasedWorker, items: list[tuple[TaskSpec, asyncio.Future]]):
         try:
+            # Lean framing (same scheme as actor pushes): per-conn interning
+            # of (options, fn) constants; repeat calls ship small tuples.
+            interned = w.conn.meta.setdefault("opts_out", {})
+            wire = []
             for spec, _ in items:
                 if spec.num_returns == -1:
                     self.core._stream_conns[spec.task_id.binary()] = w.conn
-            reply = await w.conn.call("push_tasks", {"specs": [s for s, _ in items]})
+                key = (id(spec.options), spec.fn_id)
+                ent = interned.get(key)
+                if ent is None:
+                    if len(interned) >= 512:
+                        # Unbounded distinct options: stop interning.
+                        wire.append({"spec": spec})
+                        continue
+                    oid_small = len(interned)
+                    interned[key] = (spec.options, oid_small)  # pin: id() stays valid
+                    wire.append({"spec": spec, "oid": oid_small})
+                else:
+                    wire.append({"lean": (
+                        spec.task_id.binary(), spec.args_blob, spec.num_returns, ent[1],
+                    )})
+            reply = await w.conn.call("push_tasks", {"specs": wire})
             for (spec, fut), r in zip(items, reply["results"]):
                 self.core._absorb_task_reply(spec, r, fut)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
@@ -355,6 +373,11 @@ class CoreWorker:
             reply = await self.daemon.call("register_worker", {"worker_id": self.worker_id, "address": self.address})
             self.node_id = reply["node_id"]
             self.config = Config.from_dict(reply["config"])
+            if self.store is not None:
+                # The store client predates the config push: re-apply
+                # settings that change ITS behavior (a worker without the
+                # pushed spill dir could never spill under pressure).
+                self.store.spill_dir = self.config.object_spill_dir or None
 
             # Die with the parent daemon (reference:
             # CoreWorker::ExitIfParentRayletDies, core_worker.h:1427): an
@@ -386,6 +409,8 @@ class CoreWorker:
         reply = await conn.call("register_job", payload)
         self.job_id = JobID(reply["job_id"])
         self.config = Config.from_dict(reply["config"])
+        if self.store is not None:
+            self.store.spill_dir = self.config.object_spill_dir or None
         self._register_reply = reply
 
     async def subscribe_channel(self, channel: str, callback):
@@ -1106,7 +1131,10 @@ class CoreWorker:
             if gen is not None:
                 self._streaming[task_id.binary()] = gen
             self._register_returns(return_refs)
-            asyncio.ensure_future(self._submit(spec, dep_refs))
+            if dep_refs:
+                asyncio.ensure_future(self._submit(spec, dep_refs))
+            else:
+                self._enqueue_submit(spec)
 
         self.loop.call_soon_threadsafe(_go)
         for r in return_refs:
@@ -1119,17 +1147,20 @@ class CoreWorker:
             rec.local_refs += 1
 
     async def _submit(self, spec: TaskSpec, dep_refs: list[ObjectRef]):
-        if dep_refs:
-            self._inflight_deps[spec.task_id.binary()] = dep_refs
+        self._inflight_deps[spec.task_id.binary()] = dep_refs
         # Resolve dependencies BEFORE leasing (dependency_resolver.h) so a
         # queued task never holds a worker while waiting on its args.
-        if dep_refs:
-            await self._wait_deps(dep_refs)
+        await self._wait_deps(dep_refs)
+        self._enqueue_submit(spec)
+
+    def _enqueue_submit(self, spec: TaskSpec):
+        """Hand the (dep-free) spec to its scheduling-key submitter. Plain
+        function so the no-deps fast path skips a per-call coroutine+task."""
         key = scheduling_key(spec.fn_id, spec.options)
         sub = self._submitters.get(key)
         if sub is None:
             sub = self._submitters[key] = _KeySubmitter(self, key, spec.options)
-        fut = asyncio.get_running_loop().create_future()
+        fut = self.loop.create_future()
         fut.add_done_callback(lambda f: f.exception())  # results absorbed via _absorb_task_reply
         sub.queue.append((spec, fut))
         self._event("task_submitted", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
@@ -1232,12 +1263,30 @@ class CoreWorker:
         """Execute a batch of pushed tasks sequentially (batched PushTask:
         amortizes per-frame overhead when the submitter's queue is deep;
         execution order and one-at-a-time semantics are unchanged)."""
-        return {"results": [await self.handle_push_task(conn, {"spec": s}) for s in p["specs"]]}
+        return {"results": [await self.handle_push_task(conn, s) for s in p["specs"]]}
+
+    def _decode_pushed(self, conn, p) -> TaskSpec:
+        """Wire -> TaskSpec: full spec (interning its constants under the
+        caller's small int) or a lean tuple referencing interned constants."""
+        spec = p.get("spec")
+        if spec is not None:
+            oid = p.get("oid")
+            if oid is not None:
+                conn.meta.setdefault("opts_in", {})[oid] = (
+                    spec.options, spec.job_id, spec.caller_addr, spec.fn_id
+                )
+            return spec
+        tid, args_blob, num_returns, oid = p["lean"]
+        options, job_id, caller_addr, fn_id = conn.meta["opts_in"][oid]
+        return TaskSpec(
+            task_id=TaskID(tid), job_id=job_id, fn_id=fn_id, args_blob=args_blob,
+            num_returns=num_returns, options=options, caller_addr=caller_addr,
+        )
 
     async def handle_push_task(self, conn, p):
         """Execute a pushed task (reference: CoreWorkerService.PushTask ->
         TaskReceiver -> scheduling queue -> execute callback)."""
-        spec: TaskSpec = p["spec"]
+        spec = self._decode_pushed(conn, p)
         fn = await self._load_callable(spec.fn_id)
         loop = asyncio.get_running_loop()
         self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
@@ -1433,17 +1482,18 @@ class CoreWorker:
             if gen is not None:
                 self._streaming[task_id.binary()] = gen
             self._register_returns(refs)
-            asyncio.ensure_future(self._submit_actor_task(spec, dep_refs))
+            self._submit_actor_task(spec, dep_refs)
 
         self.loop.call_soon_threadsafe(_go)
         for r in refs:
             r._registered = True
         return gen if streaming else refs
 
-    async def _submit_actor_task(self, spec: TaskSpec, dep_refs):
+    def _submit_actor_task(self, spec: TaskSpec, dep_refs):
         # Per-actor FIFO pump: submission order must equal wire order (actor
         # tasks execute in arrival order on the executor). A create_task per
-        # spec would let conn-setup/dep awaits interleave and reorder sends.
+        # spec would let conn-setup/dep awaits interleave and reorder sends;
+        # a plain enqueue also keeps the per-call hot path task-free.
         q = self._actor_send_queues.get(spec.actor_id)
         if q is None:
             q = self._actor_send_queues[spec.actor_id] = asyncio.Queue()
@@ -1546,10 +1596,32 @@ class CoreWorker:
                 if not entry["addr"]:
                     await self._refresh_actor_addr(actor_id, entry)
                 entry["conn"] = await self._peer_conn(entry["addr"])
+            interned = entry["conn"].meta.setdefault("opts_out", {})
             for spec in specs:
                 if spec.num_returns == -1:
                     self._stream_conns[spec.task_id.binary()] = entry["conn"]
-                sent.append((spec, entry["conn"].call_start("push_actor_task", {"spec": spec})))
+                # Lean framing: ship the per-handle constants (options, ids,
+                # caller) once per conn, then small tuples — a full TaskSpec
+                # costs ~15x a tuple to (un)pickle, the dominant per-call
+                # cost for tiny actor calls (reference keeps specs on the
+                # wire but pickles them in C++).
+                key = (id(spec.options), spec.actor_id)
+                ent = interned.get(key)
+                if ent is None:
+                    if len(interned) >= 512:
+                        # Unbounded distinct options (per-call .options()
+                        # clones): stop interning, ship full specs.
+                        sent.append((spec, entry["conn"].call_start("push_actor_task", {"spec": spec})))
+                        continue
+                    oid_small = len(interned)
+                    interned[key] = (spec.options, oid_small)  # pin: id() stays valid
+                    payload = {"spec": spec, "oid": oid_small}
+                else:
+                    payload = {"lean": (
+                        spec.task_id.binary(), spec.method_name, spec.args_blob,
+                        spec.num_returns, spec.concurrency_group, ent[1],
+                    )}
+                sent.append((spec, entry["conn"].call_start("push_actor_task", payload)))
             # Backpressure: bound the transport buffer before the next drain.
             await entry["conn"].flush()
         except ActorDiedError as e:
@@ -1688,7 +1760,26 @@ class CoreWorker:
     async def handle_push_actor_task(self, conn, p):
         if self._actor_runtime is None:
             raise rpc.RpcError("no actor hosted on this worker")
-        return await self._actor_runtime.execute(p["spec"], conn)
+        spec = p.get("spec")
+        if spec is not None:
+            # Full spec: intern its per-handle constants under the caller's
+            # small int so subsequent calls can ride the lean frame (a full
+            # TaskSpec costs ~15x a small tuple to (un)pickle on the wire —
+            # the dominant per-call cost for tiny actor calls on one core).
+            oid = p.get("oid")
+            if oid is not None:
+                conn.meta.setdefault("opts_in", {})[oid] = (
+                    spec.options, spec.job_id, spec.caller_addr, spec.actor_id
+                )
+        else:
+            tid, method, args_blob, num_returns, cg, oid = p["lean"]
+            options, job_id, caller_addr, actor_id = conn.meta["opts_in"][oid]
+            spec = TaskSpec(
+                task_id=TaskID(tid), job_id=job_id, fn_id="", args_blob=args_blob,
+                num_returns=num_returns, options=options, caller_addr=caller_addr,
+                actor_id=actor_id, method_name=method, concurrency_group=cg,
+            )
+        return await self._actor_runtime.execute(spec, conn)
 
 
     # -- compiled DAG stages (ray_tpu.dag; channels ride the existing peer
@@ -1711,6 +1802,39 @@ class CoreWorker:
     def handle_store_path(self, conn, p):
         """Arena identity probe: same path = same node = zero-copy dag edges."""
         return self.store.path if self.store is not None else ""
+
+    async def handle_profile_cpu(self, conn, p):
+        """On-demand CPU profile of THIS worker: sample every thread's stack
+        for `duration_s`, return collapsed stacks with counts (the dashboard's
+        py-spy-equivalent, reference: dashboard/modules/reporter/
+        profile_manager.py:60-100 — here in-process via sys._current_frames,
+        no external profiler binary). Runs on an executor thread so the IO
+        loop keeps serving while sampling."""
+        duration = min(float(p.get("duration_s", 2.0)), 30.0)
+        interval = max(float(p.get("interval_s", 0.01)), 0.001)
+
+        def sample():
+            import sys
+            import traceback as tb
+
+            counts: dict[str, int] = {}
+            end = time.monotonic() + duration
+            n = 0
+            while time.monotonic() < end:
+                for tid, frame in sys._current_frames().items():
+                    if tid == threading.get_ident():
+                        continue  # the sampler itself
+                    stack = ";".join(
+                        f"{f.name} ({f.filename.rsplit('/', 1)[-1]}:{f.lineno})"
+                        for f in tb.extract_stack(frame)
+                    )
+                    counts[stack] = counts.get(stack, 0) + 1
+                n += 1
+                time.sleep(interval)
+            return {"samples": n, "duration_s": duration, "stacks": counts}
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, sample)
 
     def handle_dag_shm_ack(self, conn, p):
         from ray_tpu.dag.runtime import dag_shm_ack

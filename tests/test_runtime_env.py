@@ -65,3 +65,66 @@ def test_unknown_key_rejected(shared_ray):
 
     with pytest.raises(ValueError, match="unsupported runtime_env"):
         f.remote()
+
+
+def _write_pkg(root, name, version):
+    """A minimal installable package exposing conflictlib.__version__."""
+    import os
+
+    pkg = os.path.join(str(root), f"{name}_v{version.replace('.', '_')}")
+    os.makedirs(os.path.join(pkg, "conflictlib"), exist_ok=True)
+    with open(os.path.join(pkg, "pyproject.toml"), "w") as f:
+        f.write(
+            "[build-system]\nrequires = []\nbuild-backend = 'setuptools.build_meta'\n"
+            f"[project]\nname = 'conflictlib'\nversion = '{version}'\n"
+        )
+    with open(os.path.join(pkg, "conflictlib", "__init__.py"), "w") as f:
+        f.write(f"__version__ = {version!r}\n")
+    with open(os.path.join(pkg, "setup.py"), "w") as f:
+        f.write(
+            "from setuptools import setup\n"
+            f"setup(name='conflictlib', version={version!r}, packages=['conflictlib'])\n"
+        )
+    return pkg
+
+
+def test_pip_venv_isolation_and_cache(shared_ray, tmp_path):
+    """Two actors with CONFLICTING package versions coexist on one cluster
+    (each runs from its own cached venv — reference: _private/runtime_env/
+    pip.py + uri_cache.py); a second use of the same env hits the venv cache
+    (no rebuild)."""
+    import glob
+    import os
+
+    import ray_tpu as rt
+
+    p1 = _write_pkg(tmp_path, "conflictlib", "1.0")
+    p2 = _write_pkg(tmp_path, "conflictlib", "2.0")
+    opts = ["--no-index", "--no-build-isolation"]  # zero-egress host
+
+    @rt.remote
+    class Probe:
+        def version(self):
+            import conflictlib
+
+            return conflictlib.__version__
+
+    a1 = Probe.options(runtime_env={"pip": [p1], "pip_install_options": opts}).remote()
+    a2 = Probe.options(runtime_env={"pip": [p2], "pip_install_options": opts}).remote()
+    # Concurrent: both alive at once, each seeing ITS version.
+    v1 = rt.get(a1.version.remote(), timeout=300)
+    v2 = rt.get(a2.version.remote(), timeout=300)
+    assert (v1, v2) == ("1.0", "2.0")
+    # Venvs were built once each, content-hash keyed.
+    venv_dirs = glob.glob("/tmp/raytpu_*/runtime_envs/venvs/*")
+    assert len({os.path.basename(d) for d in venv_dirs}) >= 2
+
+    # Cache hit: a THIRD actor with the same env reuses the built venv (fast
+    # path returns the existing python; no .tmp build dir appears).
+    before = set(glob.glob("/tmp/raytpu_*/runtime_envs/venvs/*"))
+    a3 = Probe.options(runtime_env={"pip": [p1], "pip_install_options": opts}).remote()
+    assert rt.get(a3.version.remote(), timeout=300) == "1.0"
+    after = set(glob.glob("/tmp/raytpu_*/runtime_envs/venvs/*"))
+    assert after == before, "same env rebuilt instead of cache hit"
+    for a in (a1, a2, a3):
+        rt.kill(a)
