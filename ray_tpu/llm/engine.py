@@ -1,25 +1,43 @@
-"""Continuous-batching LLM engine: slot-based KV cache, bucketed prefill,
-single jitted decode step.
+"""Continuous-batching LLM engine: block-paged KV cache, bucketed prefill,
+fused decode blocks.
 
 TPU-first design (vs the reference's delegation to vLLM,
 llm/_internal/serve/engines/vllm/vllm_engine.py:174):
-- Static shapes everywhere: the KV cache is [L, max_slots, max_seq, KV, Hd];
-  prompts prefill into a slot through one of a few length-bucketed jitted
-  programs; decoding is ONE jitted step over all slots per iteration, active
-  or not — XLA sees two programs total, not a shape per batch composition.
-- Continuous batching is the host loop: between steps, finished slots retire
-  and queued requests prefill into free slots; decode never waits for a
-  full batch (vLLM's iteration-level scheduling, re-expressed statically).
+- Static shapes everywhere: the KV cache is a linear page pool
+  [L, KV, total_pages*page_size, Hd]; prompts prefill through a few
+  length-bucketed jitted programs; decoding is ONE jitted block over all
+  slots per iteration — XLA sees a handful of programs total, not a shape
+  per batch composition.
+- Paged KV (vLLM's core idea, re-expressed for XLA): each sequence owns a
+  page list; prefill scatters K/V into its pages, decode scatters one token
+  at (page[len // ps], len % ps) and attends through the page table with the
+  Pallas paged-attention kernel (ops/paged_attention.py — scalar-prefetch
+  page-table walk, no materialized gather). Memory scales with reserved
+  pages, not slots × max_seq; admission is page-budgeted, so many more slots
+  than a dense cache can be configured.
+- Continuous batching is the host loop: between device programs, finished
+  slots retire (their pages return to the free list) and queued requests
+  prefill into free slots. Prefill groups are dispatched back-to-back
+  asynchronously and fetched in order, so a request's TTFT is its own
+  group's completion, not the whole admission wave's.
+- Admission-aware decode: under queue pressure the decode block shrinks
+  (fewer fused steps per host round trip) so waiting requests reach a
+  prefill slot sooner; with an empty queue full blocks amortize the
+  tunneled-chip round-trip latency.
 - GQA cache: K/V stored at kv-head count (the HBM saving is what makes long
-  max_seq fit); decode attention reads grouped heads directly.
+  contexts fit); the paged kernel reads grouped heads directly.
 
 TTFT is measured from request arrival to its first sampled token (prefill
 completes inside that window), the standard serving definition.
+
+Page-0 convention: page 0 is never allocated; dead page-table entries point
+at it (the paged kernel masks them by length) and it absorbs writes from
+retired/overshooting slots (their lengths are zeroed, so nothing ever reads
+what they wrote).
 """
 from __future__ import annotations
 
 import dataclasses
-import functools
 import math
 import time
 from collections import deque
@@ -30,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.models.transformer import TransformerConfig, _dense_ffn, _rms_norm, _rope, init_params
+from ray_tpu.ops.paged_attention import paged_attention
 
 
 @dataclasses.dataclass
@@ -45,12 +64,21 @@ class EngineConfig:
     # a block of N amortizes it N-fold. Cost: admissions happen between
     # blocks, and a slot finishing mid-block discards its tail tokens.
     decode_block: int = 8
+    # KV page size (tokens). max_seq must be a multiple; prefill buckets are
+    # rounded up to multiples.
+    page_size: int = 128
+    # Page-pool size. 0 -> dense parity (max_slots * max_seq / page_size) + 1.
+    # Smaller pools trade concurrency ceilings for memory: admission reserves
+    # ceil((prompt + max_tokens + decode_block)/page_size) pages per request
+    # and queues when the pool is dry.
+    total_pages: int = 0
 
 
 @dataclasses.dataclass
 class _Slot:
     req_id: str
     max_tokens: int
+    pages: list  # page ids owned by this request
     emitted: list = dataclasses.field(default_factory=list)
     n_generated: int = 0  # dispatched count (values may still be on device)
     arrived_at: float = 0.0
@@ -84,35 +112,6 @@ def _prefill_layer(x, lp, cfg: TransformerConfig, positions, seg):
     return x, k, v
 
 
-def _decode_layer(x, lp, ck, cv, cfg: TransformerConfig, lengths):
-    """One-token step against the cache. x: [B,1,D]; ck/cv: [B,S,KV,Hd]
-    (this layer's slice); lengths: [B] = tokens already in cache."""
-    dt = x.dtype
-    B = x.shape[0]
-    S = ck.shape[1]
-    KV, Hd = ck.shape[2], ck.shape[3]
-    group = cfg.n_heads // cfg.kv_heads
-    h = _rms_norm(x, lp["attn_norm"])
-    q, k_new, v_new = _attn_proj(h, lp, cfg, dt)  # q:[B,1,H,Hd] k/v:[B,1,KV,Hd]
-    pos = lengths[:, None]
-    q = _rope(q, pos, cfg.rope_theta)
-    k_new = _rope(k_new, pos, cfg.rope_theta)
-    rows = jnp.arange(B)
-    ck = ck.at[rows, lengths].set(k_new[:, 0])
-    cv = cv.at[rows, lengths].set(v_new[:, 0])
-    qg = q[:, 0].reshape(B, KV, group, Hd)
-    scores = jnp.einsum("bkgh,bskh->bkgs", qg, ck).astype(jnp.float32)
-    scores = scores / math.sqrt(Hd)
-    valid = (jnp.arange(S)[None, :] <= lengths[:, None])[:, None, None, :]
-    scores = jnp.where(valid, scores, -1e30)
-    p = jax.nn.softmax(scores, axis=-1).astype(dt)
-    o = jnp.einsum("bkgs,bskh->bkgh", p, cv).reshape(B, 1, cfg.n_heads, Hd)
-    x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
-    h = _rms_norm(x, lp["ffn_norm"])
-    x = x + _dense_ffn(h, lp)
-    return x, ck, cv
-
-
 def _sample(logits, temperature, key):
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -129,13 +128,26 @@ class LLMEngine:
         self.ec = engine_config or EngineConfig()
         if self.ec.max_seq <= 0:
             self.ec = dataclasses.replace(self.ec, max_seq=cfg.max_seq_len)
+        S = self.ec.max_seq
+        ps = self.ec.page_size
+        if S % ps:
+            raise ValueError(f"max_seq {S} must be a multiple of page_size {ps}")
+        if self.ec.total_pages <= 0:
+            self.ec = dataclasses.replace(
+                self.ec, total_pages=self.ec.max_slots * (S // ps) + 1
+            )
         self.params = params if params is not None else init_params(jax.random.PRNGKey(self.ec.seed), cfg)
         L = cfg.n_layers
-        S = self.ec.max_seq
         B = self.ec.max_slots
-        cache_shape = (L, B, S, cfg.kv_heads, cfg.head_dim)
-        self.cache_k = jnp.zeros(cache_shape, cfg.dtype)
-        self.cache_v = jnp.zeros(cache_shape, cfg.dtype)
+        P_total = self.ec.total_pages
+        self.ppseq = S // ps  # page-table width (max pages per sequence)
+        # Linear page pool: position (page, offset) lives at page*ps + offset.
+        pool_shape = (L, cfg.kv_heads, P_total * ps, cfg.head_dim)
+        self.k_pages = jnp.zeros(pool_shape, cfg.dtype)
+        self.v_pages = jnp.zeros(pool_shape, cfg.dtype)
+        self.free_pages: deque = deque(range(1, P_total))  # page 0 = dead sink
+        self.page_tables = np.zeros((B, self.ppseq), np.int32)
+        self.d_page_tables = jnp.zeros((B, self.ppseq), jnp.int32)
         self.lengths = np.zeros(B, np.int32)  # host copy drives scheduling
         # Device-resident mirrors: decode blocks read/advance these without
         # any host->device transfer per step.
@@ -145,20 +157,35 @@ class LLMEngine:
         self.waiting: deque = deque()
         self._key = jax.random.PRNGKey(self.ec.seed + 1)
         self._prefill_jit: dict[int, Any] = {}
-        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2), static_argnums=(5,))
-        self.buckets = tuple(
-            sorted({min(b, S) for b in self.ec.prefill_buckets if b <= S} | {S})
-        )
+        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2), static_argnums=(6,))
+        # Buckets: page-size multiples only (a prefill writes whole pages).
+        self.buckets = tuple(sorted(
+            {min(ps * math.ceil(b / ps), S) for b in self.ec.prefill_buckets if b <= S} | {S}
+        ))
         # Prefill group sizes, largest-first (greedy grouping caps the
         # number of compiled (bucket, k) programs at |buckets| x |k_buckets|).
         self.k_buckets = (8, 4, 2, 1)
+        # Decode block sizes: full (empty queue) and short (queue pressure —
+        # waiting requests reach prefill sooner between shorter blocks).
+        self.block_sizes = tuple(sorted({self.ec.decode_block, max(1, self.ec.decode_block // 4)}))
+
+    # -- page accounting ---------------------------------------------------
+    def _pages_needed(self, prompt_len: int, max_tokens: int) -> int:
+        # + decode_block: a block may overshoot a slot's budget before the
+        # host absorbs it; the slack pages keep those writes inside the
+        # request's own reservation.
+        total = min(prompt_len + max_tokens + self.ec.decode_block, self.ec.max_seq)
+        return math.ceil(total / self.ec.page_size)
 
     # -- jitted programs ---------------------------------------------------
-    def _prefill_impl(self, params, cache_k, cache_v, tokens, length, slot, key):
-        """tokens: [P] (padded); writes K/V into the slot, returns the first
-        generated token + updated caches."""
+    def _prefill_impl(self, params, k_pages, v_pages, tokens, length, page_idxs, key):
+        """tokens: [P] (padded to the bucket); page_idxs: [P // ps] page ids
+        (trailing entries may be 0 = dead sink). Writes K/V pages, returns
+        the first generated token + updated pools."""
         cfg = self.cfg
+        ps = self.ec.page_size
         P = tokens.shape[0]
+        n_pg = P // ps
         x = params["embed"].astype(cfg.dtype)[tokens][None]  # [1,P,D]
         pos = jnp.arange(P, dtype=jnp.int32)[None]
         seg = (pos >= length).astype(jnp.int32)  # pads = their own segment
@@ -166,62 +193,99 @@ class LLMEngine:
         def scan_fn(h, xs):
             lp, ck_l, cv_l = xs
             h, k_new, v_new = _prefill_layer(h, lp, cfg, pos, seg)
-            ck_l = jax.lax.dynamic_update_slice(ck_l, k_new.astype(ck_l.dtype), (slot, 0, 0, 0))
-            cv_l = jax.lax.dynamic_update_slice(cv_l, v_new.astype(cv_l.dtype), (slot, 0, 0, 0))
+            # [1,P,KV,Hd] -> [KV,P,Hd]; scatter page chunks into the pool.
+            kt = k_new[0].transpose(1, 0, 2).astype(ck_l.dtype)
+            vt = v_new[0].transpose(1, 0, 2).astype(cv_l.dtype)
+
+            def write(p, pools):
+                ck, cv = pools
+                start = page_idxs[p] * ps
+                ck = jax.lax.dynamic_update_slice(
+                    ck, jax.lax.dynamic_slice(kt, (0, p * ps, 0), (cfg.kv_heads, ps, cfg.head_dim)),
+                    (0, start, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, jax.lax.dynamic_slice(vt, (0, p * ps, 0), (cfg.kv_heads, ps, cfg.head_dim)),
+                    (0, start, 0))
+                return ck, cv
+
+            ck_l, cv_l = jax.lax.fori_loop(0, n_pg, write, (ck_l, cv_l))
             return h, (ck_l, cv_l)
 
-        x, (new_k, new_v) = jax.lax.scan(scan_fn, x, (params["layers"], cache_k, cache_v))
+        x, (k_pages, v_pages) = jax.lax.scan(scan_fn, x, (params["layers"], k_pages, v_pages))
         x = _rms_norm(x, params["final_norm"])
         last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
         logits = last @ params["lm_head"].astype(cfg.dtype)
         tok = _sample(logits.astype(jnp.float32), self.ec.temperature, key)
-        return new_k, new_v, tok
+        return k_pages, v_pages, tok
 
-    def _decode_impl(self, params, cache_k, cache_v, last_tokens, lengths, n_steps, key):
+    def _decode_impl(self, params, k_pages, v_pages, last_tokens, lengths, page_tables, n_steps, key):
         """n_steps tokens for every slot in ONE device program (outer scan
         over steps, inner scan over layers): one host round trip per block.
-        Returns (cache_k, cache_v, toks [n_steps, B], last', lengths')."""
+        Returns (k_pages, v_pages, toks [n_steps, B], last', lengths')."""
         cfg = self.cfg
+        ps = self.ec.page_size
+        B = page_tables.shape[0]
+        rows = jnp.arange(B)
 
         def one_step(carry, step_key):
-            ck, cv, last, lens = carry
+            kp, vp, last, lens = carry
             x = params["embed"].astype(cfg.dtype)[last][:, None, :]  # [B,1,D]
+            # Linear write position per slot: its page for len, plus offset.
+            lin = page_tables[rows, lens // ps] * ps + lens % ps  # [B]
 
             def scan_fn(h, xs):
                 lp, ck_l, cv_l = xs
-                h, ck_l, cv_l = _decode_layer(h, lp, ck_l, cv_l, cfg, lens)
+                dt = h.dtype
+                hh = _rms_norm(h, lp["attn_norm"])
+                q, k_new, v_new = _attn_proj(hh, lp, cfg, dt)
+                pos = lens[:, None]
+                q = _rope(q, pos, cfg.rope_theta)
+                k_new = _rope(k_new, pos, cfg.rope_theta)
+                # [B,1,KV,Hd] -> [KV,B,Hd]; scatter at lin per slot.
+                ck_l = ck_l.at[:, lin].set(k_new[:, 0].transpose(1, 0, 2).astype(ck_l.dtype))
+                cv_l = cv_l.at[:, lin].set(v_new[:, 0].transpose(1, 0, 2).astype(cv_l.dtype))
+                o = paged_attention(
+                    q[:, 0],
+                    ck_l.reshape(cfg.kv_heads, -1, ps, cfg.head_dim),
+                    cv_l.reshape(cfg.kv_heads, -1, ps, cfg.head_dim),
+                    lens + 1,
+                    page_tables,
+                )  # [B, H, Hd]
+                h = h + jnp.einsum("bhk,hkd->bd", o, lp["wo"].astype(dt))[:, None, :]
+                hh = _rms_norm(h, lp["ffn_norm"])
+                h = h + _dense_ffn(hh, lp)
                 return h, (ck_l, cv_l)
 
-            x, (ck, cv) = jax.lax.scan(scan_fn, x, (params["layers"], ck, cv))
+            x, (kp, vp) = jax.lax.scan(scan_fn, x, (params["layers"], kp, vp))
             x = _rms_norm(x, params["final_norm"])
             logits = jnp.einsum("bsd,dv->bv", x, params["lm_head"].astype(cfg.dtype))
             toks = _sample(logits.astype(jnp.float32), self.ec.temperature, step_key)
-            return (ck, cv, toks, lens + 1), toks
+            return (kp, vp, toks, lens + 1), toks
 
         keys = jax.random.split(key, n_steps)
-        (cache_k, cache_v, last, lengths), toks = jax.lax.scan(
-            one_step, (cache_k, cache_v, last_tokens, lengths), keys
+        (k_pages, v_pages, last, lengths), toks = jax.lax.scan(
+            one_step, (k_pages, v_pages, last_tokens, lengths), keys
         )
-        return cache_k, cache_v, toks, last, lengths
+        return k_pages, v_pages, toks, last, lengths
 
-    def _prefill_batch_impl(self, params, cache_k, cache_v, tokens, lengths, slots, key):
+    def _prefill_batch_impl(self, params, k_pages, v_pages, tokens, lengths, page_idxs, key):
         """Prefill k requests of one length bucket in ONE device program
-        (scan over requests around the single-request body): one host round
-        trip per admitted group instead of one per request — on a
-        remote/tunneled chip the per-call latency dominates prefill compute,
-        so this is the main TTFT lever under load. tokens: [k, P]."""
+        (scan over requests around the single-request body): one dispatch per
+        admitted group instead of one per request — on a remote/tunneled chip
+        the per-call latency dominates prefill compute, so this is the main
+        TTFT lever under load. tokens: [k, P]; page_idxs: [k, P // ps]."""
         keys = jax.random.split(key, tokens.shape[0])
 
         def scan_req(carry, xs):
-            ck, cv = carry
-            toks_i, len_i, slot_i, key_i = xs
-            ck, cv, tok = self._prefill_impl(params, ck, cv, toks_i, len_i, slot_i, key_i)
-            return (ck, cv), tok
+            kp, vp = carry
+            toks_i, len_i, pg_i, key_i = xs
+            kp, vp, tok = self._prefill_impl(params, kp, vp, toks_i, len_i, pg_i, key_i)
+            return (kp, vp), tok
 
-        (cache_k, cache_v), toks = jax.lax.scan(
-            scan_req, (cache_k, cache_v), (tokens, lengths, slots, keys)
+        (k_pages, v_pages), toks = jax.lax.scan(
+            scan_req, (k_pages, v_pages), (tokens, lengths, page_idxs, keys)
         )
-        return cache_k, cache_v, toks  # toks: [k]
+        return k_pages, v_pages, toks  # toks: [k]
 
     def _prefill(self, bucket: int, k: int):
         fn = self._prefill_jit.get((bucket, k))
@@ -232,13 +296,11 @@ class LLMEngine:
         return fn
 
     def warmup(self, buckets=None, k_values=None):
-        """Compile every (bucket, k) prefill program and the decode block
-        before serving (the vLLM-style startup warmup): a cold compile costs
-        seconds and would otherwise land inside the first loaded requests'
-        TTFT. Executes each program once with dummy single-token requests
-        into slot 0; the device mirrors dirtied by those executions are reset
-        at the end (that reset is what makes the dummy state safe — cache
-        contents never matter for slots the scheduler considers empty)."""
+        """Compile every (bucket, k) prefill program and both decode block
+        sizes before serving (the vLLM-style startup warmup): a cold compile
+        costs seconds and would otherwise land inside the first loaded
+        requests' TTFT. Executes each program once against the dead page
+        (page 0), then resets the device mirrors it dirtied."""
         if buckets is None:
             buckets = self.buckets
         else:
@@ -250,27 +312,30 @@ class LLMEngine:
                         for x in buckets})
             )
         k_values = tuple(k_values) if k_values is not None else self.k_buckets
+        ps = self.ec.page_size
         key = jax.random.PRNGKey(0)
         for b in buckets:
             for k in k_values:
                 toks = jnp.zeros((k, b), jnp.int32)
                 lens = jnp.ones(k, jnp.int32)
-                idxs = jnp.zeros(k, jnp.int32)
-                self.cache_k, self.cache_v, td = self._prefill(b, k)(
-                    self.params, self.cache_k, self.cache_v, toks, lens, idxs, key
+                pgs = jnp.zeros((k, b // ps), jnp.int32)  # all writes -> dead page
+                self.k_pages, self.v_pages, td = self._prefill(b, k)(
+                    self.params, self.k_pages, self.v_pages, toks, lens, pgs, key
                 )
                 # The admit path's per-group mirror updates are their own tiny
                 # jitted programs, one shape variant per k — compile them here
                 # too or they land in the first loaded step's TTFT.
+                idxs = jnp.zeros(k, jnp.int32)
                 self.d_lengths = self.d_lengths.at[idxs].set(lens)
                 self.d_last = self.d_last.at[idxs].set(td)
                 jax.device_get(td)
-        out = self._decode_jit(
-            self.params, self.cache_k, self.cache_v, self.d_last, self.d_lengths,
-            self.ec.decode_block, key,
-        )
-        self.cache_k, self.cache_v = out[0], out[1]
-        jax.device_get(out[2])
+        for n in self.block_sizes:
+            out = self._decode_jit(
+                self.params, self.k_pages, self.v_pages, self.d_last, self.d_lengths,
+                self.d_page_tables, n, key,
+            )
+            self.k_pages, self.v_pages = out[0], out[1]
+            jax.device_get(out[2])
         # Reset device mirrors dirtied by the dummy executions.
         self.d_lengths = jnp.zeros(self.ec.max_slots, jnp.int32)
         self.d_last = jnp.zeros(self.ec.max_slots, jnp.int32)
@@ -279,6 +344,11 @@ class LLMEngine:
     def add_request(self, req_id: str, tokens, max_tokens: int = 64):
         if len(tokens) >= self.ec.max_seq:
             raise ValueError(f"prompt length {len(tokens)} >= max_seq {self.ec.max_seq}")
+        need = self._pages_needed(len(tokens), max_tokens)
+        if need > self.ec.total_pages - 1:
+            raise ValueError(
+                f"request needs {need} pages > pool size {self.ec.total_pages - 1}"
+            )
         self.waiting.append((req_id, np.asarray(tokens, np.int32), max_tokens, time.perf_counter()))
 
     def abort(self, req_id: str) -> None:
@@ -288,63 +358,105 @@ class LLMEngine:
         self.waiting = deque(w for w in self.waiting if w[0] != req_id)
         for i, s in enumerate(self.slots):
             if s is not None and s.req_id == req_id:
-                self.slots[i] = None
-                self.lengths[i] = 0
+                self._retire(i)
                 self.d_lengths = jnp.asarray(self.lengths)
+                self.d_page_tables = jnp.asarray(self.page_tables)
                 break
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
 
+    def _retire(self, i: int) -> None:
+        """Free slot i's pages and zero its table row (dead slots must write
+        only into page 0 while they keep decoding inside a block)."""
+        slot = self.slots[i]
+        if slot is not None:
+            self.free_pages.extend(slot.pages)
+        self.slots[i] = None
+        self.lengths[i] = 0
+        self.page_tables[i, :] = 0
+
     def step(self) -> dict:
-        """One engine iteration: admit waiting requests into free slots
-        (prefill), then one decode BLOCK (up to decode_block fused steps) for
-        all slots. Returns {req_id: {"token": int, "new_tokens": [...],
-        "finished": bool, "ttft_s": float|None, "tokens": [..] when done}}."""
+        """One engine iteration: admit waiting requests into free slots +
+        free pages (prefill, grouped by length bucket, groups dispatched
+        async then fetched in order), then one decode block for all slots.
+        Returns {req_id: {"token": int, "new_tokens": [...], "finished":
+        bool, "ttft_s": float|None, "tokens": [..] when done}}."""
         events: dict[str, dict] = {}
         retired = False
-        # 1. admit: assign waiting requests to free slots, grouped by length
-        # bucket, one batched prefill program per group — no per-request
-        # sampled-token fetch (device values feed d_last directly; host
-        # copies arrive with the single block fetch below).
+        ps = self.ec.page_size
+        # 1. admit: page-budgeted assignment of waiting requests to free slots.
         admitted: list[tuple[int, str, np.ndarray, int, int, float]] = []
         for i in range(self.ec.max_slots):
             if not self.waiting or self.slots[i] is not None:
                 continue
-            req_id, tokens, max_tokens, arrived = self.waiting.popleft()
+            req_id, tokens, max_tokens, arrived = self.waiting[0]
+            need = self._pages_needed(len(tokens), max_tokens)
+            if need > len(self.free_pages):
+                break  # head-of-line blocks until pages free (FIFO fairness)
+            self.waiting.popleft()
+            pages = [self.free_pages.popleft() for _ in range(need)]
             P = len(tokens)
             bucket = next(b for b in self.buckets if b >= P)
+            self.slots[i] = _Slot(
+                req_id=req_id, max_tokens=max_tokens, pages=pages,
+                n_generated=1, arrived_at=arrived,
+            )
+            self.lengths[i] = P
+            row = np.zeros(self.ppseq, np.int32)
+            row[: len(pages)] = pages
+            self.page_tables[i] = row
             admitted.append((i, req_id, tokens, bucket, max_tokens, arrived))
-        prefilled: list[tuple[list[int], Any]] = []  # (slot_idxs, toks_device [k])
+        # 2. dispatch prefill groups back-to-back (async), fetch in order so
+        # each group's TTFT is its own completion time.
         by_bucket: dict[int, list] = {}
         for item in admitted:
             by_bucket.setdefault(item[3], []).append(item)
+        dispatched: list[tuple[list, Any]] = []  # (chunk, toks_dev)
         for bucket, group in by_bucket.items():
+            n_pg = bucket // ps
             while group:
                 k = next(kb for kb in self.k_buckets if kb <= len(group))
                 chunk, group = group[:k], group[k:]
                 idxs = [it[0] for it in chunk]
                 padded = np.zeros((k, bucket), np.int32)
                 lens = np.zeros(k, np.int32)
-                for j, (_i, _rid, tokens, _b, _mt, _arr) in enumerate(chunk):
+                pgs = np.zeros((k, n_pg), np.int32)
+                for j, (i, _rid, tokens, _b, _mt, _arr) in enumerate(chunk):
                     padded[j, : len(tokens)] = tokens
                     lens[j] = len(tokens)
+                    own = self.page_tables[i, : n_pg]
+                    pgs[j] = own  # trailing zeros -> dead page sink
                 self._key, sub = jax.random.split(self._key)
-                self.cache_k, self.cache_v, toks_dev = self._prefill(bucket, k)(
-                    self.params, self.cache_k, self.cache_v,
-                    jnp.asarray(padded), jnp.asarray(lens),
-                    jnp.asarray(np.asarray(idxs, np.int32)), sub,
+                self.k_pages, self.v_pages, toks_dev = self._prefill(bucket, k)(
+                    self.params, self.k_pages, self.v_pages,
+                    jnp.asarray(padded), jnp.asarray(lens), jnp.asarray(pgs), sub,
                 )
-                for (i, req_id, tokens, _b, max_tokens, arrived) in chunk:
-                    self.slots[i] = _Slot(
-                        req_id=req_id, max_tokens=max_tokens, n_generated=1, arrived_at=arrived
-                    )
-                    self.lengths[i] = len(tokens)
                 idx_arr = jnp.asarray(np.asarray(idxs, np.int32))
                 self.d_lengths = self.d_lengths.at[idx_arr].set(jnp.asarray(lens))
                 self.d_last = self.d_last.at[idx_arr].set(toks_dev)
-                prefilled.append((idxs, toks_dev))
-        # 2. decode: one fused block over all slots
+                dispatched.append((chunk, toks_dev))
+        if admitted:
+            self.d_page_tables = jnp.asarray(self.page_tables)
+        # Fetch per group, in dispatch order: group g's fetch returns while
+        # g+1 still runs on device (async dispatch), so TTFT is per-group.
+        for chunk, toks_dev in dispatched:
+            group_toks = np.asarray(jax.device_get(toks_dev)).tolist()
+            now = time.perf_counter()
+            for (i, req_id, tokens, _b, _mt, arrived), tok in zip(chunk, group_toks):
+                slot = self.slots[i]
+                tok = int(tok)
+                slot.first_token_at = now
+                slot.emitted.append(tok)
+                events[req_id] = {
+                    "token": tok,
+                    "new_tokens": [tok],
+                    "finished": False,
+                    "ttft_s": now - arrived,
+                }
+                retired |= self._maybe_finish(i, events)
+        # 3. decode: one fused block over all slots. Queue pressure shrinks
+        # the block so the next admission wave starts sooner.
         active = [i for i, s in enumerate(self.slots) if s is not None]
         toks = None
         n = 0
@@ -353,36 +465,19 @@ class LLMEngine:
             positive = [r for r in remaining if r > 0]
             cap = self.ec.max_seq - 1 - int(max(self.lengths[i] for i in active))
             if positive and cap > 0:
-                # Full blocks only (overshoot past a slot's budget is
-                # discarded at absorb time): a tail-sized n would compile a
-                # fresh decode program per distinct value — seconds each on
-                # a cold cache, for a few tokens of saved compute.
-                n = int(max(1, min(self.ec.decode_block, cap)))
+                block = self.block_sizes[0] if self.waiting else self.block_sizes[-1]
+                n = int(max(1, min(block, cap)))
+                if n not in self.block_sizes:  # cap hit: snap to a compiled size
+                    n = self.block_sizes[0]
                 self._key, sub = jax.random.split(self._key)
-                (self.cache_k, self.cache_v, toks, self.d_last, self.d_lengths) = self._decode_jit(
-                    self.params, self.cache_k, self.cache_v, self.d_last, self.d_lengths, n, sub,
+                (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
+                    self.params, self.k_pages, self.v_pages, self.d_last,
+                    self.d_lengths, self.d_page_tables, n, sub,
                 )
                 for i in active:
                     self.slots[i].n_generated += n
-        # 3. ONE host fetch for everything generated this step
-        fetch = jax.device_get(([t for _, t in prefilled], toks))
-        prefill_toks, block_toks = fetch
-        now = time.perf_counter()
-        for (idxs, _), group_toks in zip(prefilled, prefill_toks):
-            for i, tok in zip(idxs, np.asarray(group_toks).tolist()):
-                slot = self.slots[i]
-                tok = int(tok)
-                slot.first_token_at = now
-                slot.emitted.append(tok)
-                events[slot.req_id] = {
-                    "token": tok,
-                    "new_tokens": [tok],
-                    "finished": False,
-                    "ttft_s": now - slot.arrived_at,
-                }
-                retired |= self._maybe_finish(i, events)
-        if block_toks is not None:
-            block_toks = np.asarray(block_toks)  # [n, B]
+        if toks is not None:
+            block_toks = np.asarray(jax.device_get(toks))  # [n, B]
             for step_i in range(n):
                 for i in active:
                     slot = self.slots[i]
@@ -397,8 +492,10 @@ class LLMEngine:
                     retired |= self._maybe_finish(i, events)
         if retired:
             # Re-sync device mirrors so retired slots stop advancing their
-            # (now meaningless) lengths toward max_seq.
+            # (now meaningless) lengths toward max_seq, and their writes land
+            # in the dead page.
             self.d_lengths = jnp.asarray(self.lengths)
+            self.d_page_tables = jnp.asarray(self.page_tables)
             last = np.zeros(self.ec.max_slots, np.int32)
             for i, s in enumerate(self.slots):
                 if s is not None:
@@ -418,8 +515,7 @@ class LLMEngine:
             ev["finished"] = True
             ev["tokens"] = list(slot.emitted)
             ev["ttft_s"] = ev.get("ttft_s") or (slot.first_token_at - slot.arrived_at)
-            self.slots[i] = None
-            self.lengths[i] = 0
+            self._retire(i)
         return bool(done)
 
     def generate(self, tokens, max_tokens: int = 64) -> dict:

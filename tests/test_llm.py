@@ -124,3 +124,86 @@ def test_llm_serve_deployment():
     finally:
         serve.shutdown()
         rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache
+# ---------------------------------------------------------------------------
+
+def test_paged_pool_memory_independent_of_slots():
+    """The point of paging: slot count is a scheduling knob, not a memory
+    multiplier. 32 slots over a 16-page pool uses 16 pages of HBM, not
+    32 x max_seq."""
+    ec = EngineConfig(max_slots=32, max_seq=128, page_size=16, total_pages=17,
+                      prefill_buckets=(16,), decode_block=2)
+    eng = LLMEngine(CFG, engine_config=ec)
+    assert eng.k_pages.shape[2] == 17 * 16  # pool tokens, NOT 32*128
+    out = eng.generate([1, 2, 3], max_tokens=4)
+    assert len(out["tokens"]) == 4
+
+
+def test_paged_admission_waits_for_pages_then_proceeds():
+    """Pool smaller than the aggregate demand: admission queues on the page
+    budget (not slot count) and every request still completes."""
+    ec = EngineConfig(max_slots=8, max_seq=128, page_size=16, total_pages=9,
+                      prefill_buckets=(16,), decode_block=2)
+    eng = LLMEngine(CFG, engine_config=ec)
+    # Each request needs ceil((3 + 8 + 2)/16) = 1 page prompt... force more:
+    # prompt 3 + max_tokens 20 + block 2 = 25 -> 2 pages. Pool has 8 usable.
+    for r in range(8):
+        eng.add_request(f"q{r}", [1, 2, 3], 20)
+    results = {}
+    concurrent_seen = 0
+    while eng.has_work():
+        active = sum(1 for s in eng.slots if s is not None)
+        concurrent_seen = max(concurrent_seen, active)
+        for rid, ev in eng.step().items():
+            if ev.get("finished"):
+                results[rid] = ev["tokens"]
+    assert len(results) == 8
+    assert concurrent_seen <= 4  # 8 usable pages / 2 pages each
+    first = results["q0"]
+    assert all(results[f"q{r}"] == first for r in range(8))  # same prompt, greedy
+
+
+def test_paged_pages_recycled_after_finish():
+    ec = EngineConfig(max_slots=2, max_seq=128, page_size=16, total_pages=9,
+                      prefill_buckets=(16,), decode_block=2)
+    eng = LLMEngine(CFG, engine_config=ec)
+    free0 = len(eng.free_pages)
+    for _ in range(3):
+        eng.generate([4, 5, 6], max_tokens=6)
+    assert len(eng.free_pages) == free0  # every reservation returned
+
+
+def test_paged_abort_frees_pages():
+    ec = EngineConfig(max_slots=2, max_seq=128, page_size=16, total_pages=9,
+                      prefill_buckets=(16,), decode_block=2)
+    eng = LLMEngine(CFG, engine_config=ec)
+    free0 = len(eng.free_pages)
+    eng.add_request("gone", [1, 2, 3], 100)
+    eng.step()  # admitted: pages reserved, decoding
+    assert len(eng.free_pages) < free0
+    eng.abort("gone")
+    assert len(eng.free_pages) == free0
+    assert not eng.has_work()
+    # Engine still serves after the abort.
+    out = eng.generate([1, 2, 3], max_tokens=4)
+    assert len(out["tokens"]) == 4
+
+
+def test_paged_decode_matches_across_pool_layouts():
+    """Same request, different page pools (dense parity vs tight pool with
+    non-trivial page scatter): identical greedy tokens."""
+    prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1]
+    outs = []
+    for total_pages in (0, 12):
+        ec = EngineConfig(max_slots=3, max_seq=128, page_size=16,
+                          prefill_buckets=(16,), total_pages=total_pages,
+                          decode_block=4)
+        eng = LLMEngine(CFG, engine_config=ec)
+        # Fragment the free list so page tables are non-contiguous.
+        eng.generate([1, 2], max_tokens=3)
+        eng.generate([3, 4, 5], max_tokens=5)
+        outs.append(eng.generate(prompt, max_tokens=12)["tokens"])
+    assert outs[0] == outs[1]
