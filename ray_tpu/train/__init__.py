@@ -14,6 +14,13 @@ from ray_tpu.train.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.scaling_policy import (
+    ElasticScalingPolicy,
+    FixedScalingPolicy,
+    NoopDecision,
+    ResizeDecision,
+    ScalingPolicy,
+)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
 
@@ -22,7 +29,12 @@ __all__ = [
     "CheckpointConfig",
     "CheckpointManager",
     "DataParallelTrainer",
+    "ElasticScalingPolicy",
     "FailureConfig",
+    "FixedScalingPolicy",
+    "NoopDecision",
+    "ResizeDecision",
+    "ScalingPolicy",
     "JaxTrainer",
     "Result",
     "RunConfig",

@@ -46,11 +46,14 @@ class TrainController:
     def __init__(self, train_fn: Callable, train_config: dict,
                  scaling: ScalingConfig, run_config: RunConfig,
                  poll_interval_s: float = 0.2, settle_period_s: float = 5.0,
-                 datasets: Optional[dict] = None):
+                 datasets: Optional[dict] = None, scaling_policy=None):
+        from ray_tpu.train.scaling_policy import FixedScalingPolicy
+
         self.train_fn = train_fn
         self.train_config = train_config
         self.scaling = scaling
         self.run_config = run_config
+        self.scaling_policy = scaling_policy or FixedScalingPolicy(scaling)
         self.datasets = datasets or {}
         self.poll_interval_s = poll_interval_s
         self.settle_period_s = settle_period_s
@@ -64,6 +67,7 @@ class TrainController:
         )
         self.state = "INIT"
         self.failures = 0
+        self.resizes = 0
         self.metrics_history: list[dict] = []
         self.latest_metrics: dict = {}
         # Seqs absorbed from the CURRENT gang (reset per restart: a restarted
@@ -83,6 +87,14 @@ class TrainController:
         while True:
             try:
                 if group is None:
+                    # The policy sizes every (re)start: fixed = configured n;
+                    # elastic = fit the gang to current cluster capacity
+                    # (reference: make_decision_for_non_running_worker_group).
+                    decision = self.scaling_policy.make_decision_for_non_running_worker_group()
+                    if decision.num_workers != self.scaling.num_workers:
+                        self.scaling = dataclasses.replace(
+                            self.scaling, num_workers=decision.num_workers
+                        )
                     self._seen_ckpt_seqs.clear()
                     self._metric_entries.clear()
                     self._max_metric_seq = -1
@@ -146,6 +158,32 @@ class TrainController:
             if all(s["finished"] for s in status):
                 self.state = "DONE"
                 break
+            decision = self.scaling_policy.make_decision_for_running_worker_group(status)
+            if (
+                getattr(decision, "num_workers", None) is not None
+                and decision.num_workers != len(group.workers)
+            ):
+                # Elastic resize (reference: _execute_resize_decision,
+                # controller.py:183): graceful-stop the gang so every rank's
+                # final report/checkpoint is absorbed, rebuild at the new
+                # size, resume from the latest checkpoint with the new mesh.
+                # NOT a failure: does not consume the failure budget.
+                self.state = "RESIZING"
+                self.resizes += 1
+                group.stop_all()
+                deadline = time.monotonic() + self.settle_period_s
+                while time.monotonic() < deadline:
+                    try:
+                        status = group.poll()
+                        self._absorb_reports(status)
+                        if all(s["finished"] or s["error"] for s in status):
+                            break
+                    except Exception:
+                        break
+                    time.sleep(self.poll_interval_s)
+                group.shutdown()
+                group = None
+                continue
             time.sleep(self.poll_interval_s)
 
         if group is not None:
@@ -224,6 +262,8 @@ class TrainController:
         return {
             "state": self.state,
             "failures": self.failures,
+            "resizes": self.resizes,
+            "world_size": self.scaling.num_workers,
             "reported": len(self.metrics_history),
             "latest_metrics": self.latest_metrics,
         }

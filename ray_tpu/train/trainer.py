@@ -26,11 +26,15 @@ class DataParallelTrainer:
         run_config: Optional[RunConfig] = None,
         datasets: Optional[dict] = None,
         controller_as_actor: bool = True,
+        scaling_policy=None,
     ):
         self.train_fn = train_loop_per_worker
         self.train_config = train_loop_config or {}
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        # Optional elastic policy (ray_tpu.train.ElasticScalingPolicy);
+        # None = FixedScalingPolicy(scaling_config).
+        self.scaling_policy = scaling_policy
         # {name: ray_tpu.data.Dataset}; each gets streaming_split across the
         # gang, consumed in the train fn via train.get_dataset_shard(name)
         # (reference: DataParallelTrainer datasets= + data_config.py:13).
@@ -47,12 +51,12 @@ class DataParallelTrainer:
             Controller = rt.remote(TrainController)
             handle = Controller.options(max_concurrency=2, num_cpus=0).remote(
                 self.train_fn, self.train_config, self.scaling, self.run_config,
-                datasets=self.datasets,
+                datasets=self.datasets, scaling_policy=self.scaling_policy,
             )
             return rt.get(handle.run.remote(), timeout=None)
         return TrainController(
             self.train_fn, self.train_config, self.scaling, self.run_config,
-            datasets=self.datasets,
+            datasets=self.datasets, scaling_policy=self.scaling_policy,
         ).run()
 
 

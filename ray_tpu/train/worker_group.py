@@ -218,6 +218,17 @@ class WorkerGroup:
                 )
         return out
 
+    def stop_all(self) -> None:
+        """Graceful stop: set every rank's stop event (its next report()
+        raises, ending the train thread) — used by elastic resize so final
+        checkpoints drain before teardown."""
+        refs = [w.stop.remote() for w in self.workers]
+        for r in refs:
+            try:
+                rt.get(r, timeout=10)
+            except Exception:
+                pass
+
     def shutdown(self) -> None:
         for w in self.workers:
             try:
