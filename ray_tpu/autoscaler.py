@@ -42,10 +42,11 @@ class NodeProvider:
         """provider_id -> node_type name."""
         raise NotImplementedError
 
-    def controller_node_id(self, provider_id: str) -> Optional[str]:
+    def controller_node_id(self, provider_id: str, nodes: Optional[dict] = None) -> Optional[str]:
         """Map a provider instance to its registered controller node id (used
-        to check THAT node's idleness before terminating it). None = unknown
-        (the autoscaler will then never downscale it)."""
+        to check THAT node's idleness before terminating it). `nodes` is the
+        controller's node table for providers that map via labels. None =
+        unknown (the autoscaler will then never downscale it)."""
         return None
 
 
@@ -73,7 +74,7 @@ class LocalNodeProvider(NodeProvider):
     def non_terminated_nodes(self) -> dict[str, str]:
         return {pid: tname for pid, (_, tname) in self._nodes.items()}
 
-    def controller_node_id(self, provider_id: str) -> Optional[str]:
+    def controller_node_id(self, provider_id: str, nodes: Optional[dict] = None) -> Optional[str]:
         daemon, _ = self._nodes.get(provider_id, (None, None))
         return None if daemon is None else daemon.node_id
 
@@ -94,6 +95,7 @@ class Autoscaler:
         self.idle_timeout_s = idle_timeout_s
         self.max_launches = max_launches_per_update
         self._idle_since: dict[str, float] = {}
+        self._draining: dict[str, str] = {}  # provider_id -> controller node id
 
     def _cluster_state(self) -> dict:
         from ray_tpu.core import api
@@ -192,9 +194,13 @@ class Autoscaler:
             for _ in range(n):
                 self.provider.create_node(self.node_types[tname])
 
-        # Downscale: an autoscaled node may terminate only when ITS controller
-        # node (mapped via provider.controller_node_id) has been fully idle —
-        # available == total — past the timeout, with no pending demand.
+        # Downscale (two-phase, reference: DrainRaylet before instance
+        # termination — node_manager.proto DrainRaylet):
+        #   1. idle past timeout -> DRAIN the controller node (scheduler stops
+        #      placing new work there), remember it;
+        #   2. next update, still idle -> terminate; anything landed/running
+        #      in between -> undrain and reset the timer (never kill
+        #      in-flight work).
         terminated: list[str] = []
         now = time.time()
         idle_controller_nodes = {
@@ -206,13 +212,33 @@ class Autoscaler:
         }
         quiet = not state["pending"] and not state["pending_gangs"] and not launched
         for pid in list(self.provider.non_terminated_nodes()):
-            nid = self.provider.controller_node_id(pid)
+            nid = self.provider.controller_node_id(pid, state["nodes"])
             if quiet and nid in idle_controller_nodes:
                 first_idle = self._idle_since.setdefault(pid, now)
                 if now - first_idle >= self.idle_timeout_s:
+                    if pid not in self._draining:
+                        reply = self._call_controller("drain_node", {"node_id": nid})
+                        if reply.get("ok"):
+                            self._draining[pid] = nid
+                        continue  # terminate on the NEXT update if still idle
                     self.provider.terminate_node(pid)
                     terminated.append(pid)
                     self._idle_since.pop(pid, None)
+                    self._draining.pop(pid, None)
             else:
                 self._idle_since.pop(pid, None)  # busy/unknown: reset its timer
-        return {"launched": launched, "terminated": terminated, "unmet": len(unmet)}
+                nid_draining = self._draining.pop(pid, None)
+                if nid_draining is not None:
+                    # Work appeared while draining: reopen the node.
+                    self._call_controller("undrain_node", {"node_id": nid_draining})
+        return {"launched": launched, "terminated": terminated, "unmet": len(unmet),
+                "draining": list(self._draining)}
+
+    def _call_controller(self, method: str, payload: dict) -> dict:
+        from ray_tpu.core import api
+
+        core = api._require_worker()
+        try:
+            return core._run(core.controller.call(method, payload)) or {}
+        except Exception:
+            return {}

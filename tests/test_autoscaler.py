@@ -34,12 +34,99 @@ def test_autoscaler_scales_up_and_down():
         assert rt.get(ref, timeout=120) == 42
         assert pg.ready(timeout=30)
         rt.remove_placement_group(pg)
-        # Drain: demand gone; idle autoscaled nodes terminate after timeout.
+        # Scale-down is three-phase now: arm idle timers -> drain -> terminate.
         time.sleep(3.0)
         autoscaler.update()  # arms idle timers (post-workload idle)
         time.sleep(1.5)
-        result = autoscaler.update()
+        result = autoscaler.update()  # idle past timeout: drains first
+        assert result["draining"], result
+        result = autoscaler.update()  # still idle: terminates
         assert result["terminated"], result
     finally:
         shutdown()
         cluster.shutdown()
+
+
+def test_drain_excludes_node_from_scheduling():
+    """A draining node accepts no new work but keeps serving running actors
+    (reference: DrainRaylet semantics)."""
+    from ray_tpu.core.api import Cluster, init, shutdown
+
+    cluster = Cluster(initialize_head=False)
+    cluster.add_node(num_cpus=1, resources={"head": 1.0})
+    victim = cluster.add_node(num_cpus=2, resources={"spec": 2.0})
+    init(address=cluster.address)
+    try:
+        @rt.remote(num_cpus=1, resources={"spec": 1.0})
+        class Pinned:
+            def ping(self):
+                return "alive"
+
+        a = Pinned.remote()
+        assert rt.get(a.ping.remote(), timeout=60) == "alive"
+
+        from ray_tpu.core import api
+        core = api._require_worker()
+        reply = core._run(core.controller.call("drain_node", {"node_id": victim.node_id}))
+        assert reply["ok"] and not reply["idle"]  # actor still holds resources
+
+        # Existing actor keeps serving.
+        assert rt.get(a.ping.remote(), timeout=60) == "alive"
+        # New demand for that node's resources cannot schedule (drained).
+        @rt.remote(num_cpus=1, resources={"spec": 1.0})
+        def probe():
+            return 1
+        ref = probe.remote()
+        ready, not_ready = rt.wait([ref], timeout=2.0)
+        assert not ready, "task scheduled onto a draining node"
+        # Undrain: the task proceeds.
+        core._run(core.controller.call("undrain_node", {"node_id": victim.node_id}))
+        assert rt.get(ref, timeout=60) == 1
+    finally:
+        shutdown()
+        cluster.shutdown()
+
+
+def test_gce_tpu_provider_lifecycle():
+    """GCE TPU provider against the mocked API: single-host via nodes API,
+    multi-host via queuedResources; list/terminate round-trip."""
+    from ray_tpu.autoscaler import NodeType
+    from ray_tpu.gcp import FakeTPUAPI, GCETPUNodeProvider, PROVIDER_ID_LABEL
+
+    api = FakeTPUAPI()
+    prov = GCETPUNodeProvider("proj", "us-central2-b", api)
+    single = NodeType("v5e-1", {"TPU": 1.0}, labels={"accelerator_type": "v5litepod-1"})
+    multi = NodeType("v4-16", {"TPU": 4.0}, labels={"accelerator_type": "v4-16"})
+
+    pid1 = prov.create_node(single)
+    pid2 = prov.create_node(multi)
+    assert ("create_node", pid1) in api.calls
+    assert ("create_qr", pid2) in api.calls  # multi-host -> queued resource
+    live = prov.non_terminated_nodes()
+    assert live == {pid1: "v5e-1", pid2: "v4-16"}
+
+    # controller_node_id maps through the daemon-registered label.
+    nodes = {"n1": {"labels": {PROVIDER_ID_LABEL: pid1}}}
+    assert prov.controller_node_id(pid1, nodes) == "n1"
+    assert prov.controller_node_id(pid2, nodes) is None  # not yet registered
+
+    prov.terminate_node(pid1)
+    prov.terminate_node(pid2)
+    assert prov.non_terminated_nodes() == {}
+    assert ("delete_node", pid1) in api.calls
+    assert ("delete_qr", pid2) in api.calls
+
+
+def test_gce_queued_resource_waits_not_respawned():
+    """A parked queued resource (no capacity) still counts as non-terminated,
+    so the autoscaler does not re-request the slice every update."""
+    from ray_tpu.autoscaler import NodeType
+    from ray_tpu.gcp import FakeTPUAPI, GCETPUNodeProvider
+
+    api = FakeTPUAPI(qr_capacity=0)  # everything parks in ACCEPTED
+    prov = GCETPUNodeProvider("proj", "us-central2-b", api)
+    multi = NodeType("v4-32", {"TPU": 4.0}, labels={"accelerator_type": "v4-32"})
+    pid = prov.create_node(multi)
+    for _ in range(3):
+        assert pid in prov.non_terminated_nodes()
+    assert sum(1 for c in api.calls if c[0] == "create_qr") == 1
