@@ -1,13 +1,32 @@
-"""ray_tpu.rl: RL training on the actor/task runtime (RLlib-equivalent seed).
+"""ray_tpu.rl: RL training on the actor/task runtime (RLlib-equivalent).
 
 Role-equivalent to the reference's RLlib core split (rllib/):
-- EnvRunnerGroup (env/env_runner_group.py) -> EnvRunner actors collecting
-  rollouts from gymnasium vector envs with numpy policy forwards;
-- LearnerGroup (core/learner/learner_group.py:101) -> a jitted JAX PPO
-  learner (gang interface; DP over a mesh composes via ray_tpu.parallel);
-- Algorithm (algorithms/algorithm.py) -> PPO driver: broadcast weights,
-  parallel sample, GAE, minibatched clipped-surrogate updates.
+- EnvRunnerGroup (env/env_runner_group.py) -> EnvRunner/QEnvRunner actors
+  collecting from gymnasium vector envs with numpy policy forwards;
+- LearnerGroup (core/learner/learner_group.py:101) -> jitted JAX learners
+  (gang interface; DP over a mesh composes via ray_tpu.parallel);
+- Algorithm (algorithms/algorithm.py) -> Tune-trainable-shaped drivers:
+  - PPO (on-policy): broadcast weights, parallel sample, GAE, minibatched
+    clipped-surrogate updates;
+  - DQN (off-policy): replay-buffer actor (uniform/prioritized,
+    rllib/utils/replay_buffers/) fed by ASYNC collectors that overlap
+    learning (IMPALA-shaped pipeline), double-Q target network, PER
+    importance weights.
 """
+from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.replay_buffer import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    ReplayBufferActor,
+)
 
-__all__ = ["PPO", "PPOConfig"]
+__all__ = [
+    "DQN",
+    "DQNConfig",
+    "PPO",
+    "PPOConfig",
+    "PrioritizedReplayBuffer",
+    "ReplayBuffer",
+    "ReplayBufferActor",
+]

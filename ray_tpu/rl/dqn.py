@@ -1,0 +1,238 @@
+"""DQN: off-policy learner over the replay-buffer actor with async
+collection.
+
+Role-equivalent to the reference's DQN on the new API stack
+(rllib/algorithms/dqn/ — double-Q target, target network sync, prioritized
+replay with importance weights) with the torch Learner replaced by one
+jitted update and the sampling/learning overlap expressed with actor
+pipelining: collect tasks stay in flight on QEnvRunner actors while the
+driver-side learner consumes the buffer; weights re-broadcast between
+collects (IMPALA-shaped, rllib/algorithms/impala/ data path).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rl.module import jax_logits_values
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    collect_steps: int = 32  # env steps per collect() task
+    buffer_capacity: int = 50_000
+    prioritized: bool = True
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    batch_size: int = 64
+    updates_per_iter: int = 48
+    learning_starts: int = 1_000  # buffer size before updates begin
+    gamma: float = 0.99
+    lr: float = 1e-3
+    target_update_every: int = 200  # gradient updates between hard syncs
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_steps: int = 8_000
+    hidden: tuple = (64, 64)
+    max_grad_norm: float = 10.0
+    seed: int = 0
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQNLearner:
+    """Jitted double-DQN update with Huber loss + PER importance weights."""
+
+    def __init__(self, params: dict, cfg: DQNConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.adam(cfg.lr),
+        )
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt_state = self.optimizer.init(self.params)
+        gamma = cfg.gamma
+
+        def q_of(p, obs):
+            q, _ = jax_logits_values(p, obs)  # policy tower doubles as Q-net
+            return q
+
+        def loss_fn(p, target_p, batch):
+            q = q_of(p, batch["obs"])
+            q_sa = jnp.take_along_axis(q, batch["actions"][:, None], axis=1)[:, 0]
+            # Double DQN: online net picks the argmax, target net evaluates it.
+            next_online = q_of(p, batch["next_obs"])
+            next_a = jnp.argmax(next_online, axis=1)
+            next_target = q_of(target_p, batch["next_obs"])
+            next_q = jnp.take_along_axis(next_target, next_a[:, None], axis=1)[:, 0]
+            target = batch["rewards"] + gamma * (1.0 - batch["terms"]) * jax.lax.stop_gradient(next_q)
+            td = q_sa - target
+            huber = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td**2, jnp.abs(td) - 0.5)
+            loss = (batch["weights"] * huber).mean()
+            return loss, td
+
+        def update(p, target_p, opt_state, batch):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, target_p, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, p)
+            p = optax.apply_updates(p, updates)
+            return p, opt_state, loss, td
+
+        self._update = jax.jit(update, donate_argnums=(0, 2))
+        self._n_updates = 0
+        self._target_every = cfg.target_update_every
+
+    def update_batch(self, batch: dict) -> tuple[float, np.ndarray]:
+        import jax
+
+        self.params, self.opt_state, loss, td = self._update(
+            self.params, self.target_params, self.opt_state, batch
+        )
+        self._n_updates += 1
+        if self._n_updates % self._target_every == 0:
+            self.target_params = jax.tree.map(jax.numpy.copy, self.params)
+        return float(loss), np.asarray(td)
+
+    def get_weights(self) -> dict:
+        import jax
+
+        return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
+
+
+class DQN:
+    """Tune-trainable-shaped driver: train() returns a result dict with
+    episode_return_mean, like the PPO driver and the reference Algorithm."""
+
+    def __init__(self, config: DQNConfig):
+        import gymnasium as gym
+
+        import ray_tpu as rt
+        from ray_tpu.rl.module import init_params
+        from ray_tpu.rl.q_runner import QEnvRunner
+        from ray_tpu.rl.replay_buffer import ReplayBufferActor
+
+        self.cfg = config
+        probe = gym.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+        rng = np.random.default_rng(config.seed)
+        params = init_params(rng, obs_dim, n_actions, config.hidden)
+        self.learner = DQNLearner(params, config)
+        self.buffer = rt.remote(ReplayBufferActor).options(max_concurrency=4).remote(
+            config.buffer_capacity, prioritized=config.prioritized,
+            alpha=config.per_alpha, beta=config.per_beta, seed=config.seed,
+        )
+        runner_cls = rt.remote(QEnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                config.env, config.num_envs_per_runner, self.buffer,
+                seed=config.seed * 10_000 + i,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self.env_steps = 0
+        self.iteration = 0
+        self._recent_returns: list[float] = []
+        self._inflight: list = []  # (runner_idx, collect ref)
+        weights = self.learner.get_weights()
+        rt.get(
+            [r.set_weights.remote(weights, self._epsilon()) for r in self.runners],
+            timeout=120,
+        )
+
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self.env_steps / max(1, cfg.eps_decay_steps))
+        return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
+
+    # -- one training iteration -------------------------------------------
+    def train(self) -> dict:
+        import ray_tpu as rt
+
+        t0 = time.perf_counter()
+        cfg = self.cfg
+        # Keep one collect task in flight per runner: env stepping proceeds
+        # on the runner actors WHILE the learner updates below (the overlap).
+        while len(self._inflight) < len(self.runners):
+            busy = {i for i, _ in self._inflight}
+            idx = next(i for i in range(len(self.runners)) if i not in busy)
+            self._inflight.append((idx, self.runners[idx].collect.remote(cfg.collect_steps)))
+
+        losses = []
+        updates_done = 0
+        stats = rt.get(self.buffer.stats.remote(), timeout=60)
+        if stats["size"] >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                batch = rt.get(self.buffer.sample.remote(cfg.batch_size), timeout=60)
+                if batch is None:
+                    break
+                indices = batch.pop("indices")
+                loss, td = self.learner.update_batch(batch)
+                losses.append(loss)
+                updates_done += 1
+                if cfg.prioritized:
+                    self.buffer.update_priorities.remote(indices, td)
+
+        # Harvest every finished collect; re-dispatch with fresh weights.
+        refs = [ref for _, ref in self._inflight]
+        ready, _ = rt.wait(refs, num_returns=len(refs), timeout=None if updates_done == 0 else 0.0)
+        ready_ids = {id(r) for r in ready}
+        weights = self.learner.get_weights()
+        eps = self._epsilon()
+        still: list = []
+        for idx, ref in self._inflight:
+            if id(ref) in ready_ids:
+                out = rt.get(ref, timeout=60)
+                self.env_steps += out["steps"]
+                self._recent_returns.extend(out["episode_returns"])
+                self.runners[idx].set_weights.remote(weights, eps)
+                still.append((idx, self.runners[idx].collect.remote(cfg.collect_steps)))
+            else:
+                still.append((idx, ref))
+        self._inflight = still
+        self._recent_returns = self._recent_returns[-100:]
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(self._recent_returns)) if self._recent_returns else 0.0,
+            "env_steps_total": self.env_steps,
+            "gradient_updates": updates_done,
+            "epsilon": eps,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "buffer_size": stats["size"],
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def stop(self):
+        import ray_tpu as rt
+
+        for ref in [r for _, r in self._inflight]:
+            try:
+                rt.get(ref, timeout=10)
+            except Exception:
+                pass
+        self._inflight = []
+        for r in self.runners:
+            try:
+                rt.get(r.close.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                rt.kill(r)
+            except Exception:
+                pass
+        try:
+            rt.kill(self.buffer)
+        except Exception:
+            pass
