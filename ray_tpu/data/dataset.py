@@ -73,7 +73,26 @@ class Dataset:
             return batch.select(_cols)
         return self.map_batches(select, batch_format="pyarrow")
 
-    def repartition(self, num_blocks: int) -> "Dataset":
+    def join(self, other: "Dataset", on: str, *, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Hash join on a key column (reference:
+        _internal/execution/operators/join.py): both sides hash-partition on
+        `on`; one reduce task joins each partition pair. how: "inner"|"left".
+        Right-side column-name collisions get a ``_1`` suffix (zip's rule)."""
+        if how not in ("inner", "left"):
+            raise ValueError(f"unsupported join type {how!r} (inner|left)")
+        return Dataset(
+            LogicalOp("join", params={"on": on, "how": how,
+                                      "num_partitions": num_partitions},
+                      inputs=[self._leaf, other._leaf]),
+            self._max_in_flight,
+        )
+
+    def repartition(self, num_blocks: int, *, hash_key: Optional[str] = None) -> "Dataset":
+        if hash_key is not None:
+            # Hash-partitioned layout: all rows of a key land in ONE output
+            # block (the shuffle primitive under groupby/join, exposed).
+            return self._chain("hash_repartition", key=hash_key, num_blocks=num_blocks)
         return self._chain("repartition", num_blocks=num_blocks)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
@@ -279,12 +298,19 @@ class Dataset:
 
     def streaming_split(self, n: int, *, locality_hints=None) -> list["DataIterator"]:
         """n coordinated iterators over ONE streaming execution (one per
-        train worker; blocks dealt on demand)."""
+        train worker; blocks dealt on demand). ``locality_hints``: optional
+        list of n node ids — consumer i is preferentially dealt blocks
+        already resident on its node (reference: StreamSplitDataIterator's
+        locality_hints)."""
         import ray_tpu as rt
 
+        if locality_hints is not None and len(locality_hints) != n:
+            raise ValueError(
+                f"locality_hints must have one entry per split ({n}), got {len(locality_hints)}"
+            )
         coord_cls = rt.remote(_SplitCoordinator)
         coord = coord_cls.options(max_concurrency=max(4, n + 1)).remote(
-            self._leaf, self._max_in_flight
+            self._leaf, self._max_in_flight, locality_hints
         )
         return [DataIterator(coord, i, n) for i in builtins.range(n)]
 
@@ -323,16 +349,21 @@ def batches_from_blocks(blocks: Iterator, batch_size: int,
 # ---------------------------------------------------------------------------
 
 class GroupedData:
-    """Result of Dataset.groupby(key) — reference: grouped_data.py."""
+    """Result of Dataset.groupby(key) — reference: grouped_data.py. Executes
+    as a HASH SHUFFLE (map-side partition tasks + per-partition reduce over
+    the object store, _internal/execution/operators/hash_shuffle.py), not a
+    driver-side sort+materialize: each reduce task holds only its partition,
+    so group state never concentrates in one process."""
 
-    def __init__(self, ds: Dataset, key: str):
+    def __init__(self, ds: Dataset, key: str, num_partitions: Optional[int] = None):
         self._ds = ds
         self._key = key
+        self._num_partitions = num_partitions
 
     def map_groups(self, fn: Callable[[list], Any]) -> Dataset:
         """fn(rows) -> row-dict | list of row-dicts, per group."""
-        return self._ds._chain("groupby_map", _normalize_group_fn(fn),
-                               key=self._key)
+        return self._ds._chain("hash_groupby", _normalize_group_fn(fn),
+                               key=self._key, num_partitions=self._num_partitions)
 
     def _agg(self, agg_name: str, col: Optional[str]) -> Dataset:
         key = self._key
@@ -352,7 +383,8 @@ class GroupedData:
             elif _how == "max":
                 out[f"max({_col})"] = max(vals)
             return out
-        return self._ds._chain("groupby_map", agg, key=self._key)
+        return self._ds._chain("hash_groupby", agg, key=self._key,
+                               num_partitions=self._num_partitions)
 
     def count(self) -> Dataset:
         return self._agg("count", None)
@@ -388,13 +420,17 @@ class _SplitCoordinator:
     block (dynamic balancing), None at end of epoch.
     """
 
-    def __init__(self, leaf: LogicalOp, max_in_flight: int):
+    def __init__(self, leaf: LogicalOp, max_in_flight: int, locality_hints=None):
         import threading
 
         self.leaf = leaf
         self.max_in_flight = max_in_flight
+        self.locality_hints = list(locality_hints) if locality_hints else None
         self.epoch = 0
         self.stream: Optional[Iterator] = None
+        # Small look-ahead buffer of undealt refs: locality matching picks
+        # from here; bounded so the coordinator never races far ahead.
+        self._ready: list = []
         # Dealt refs stay pinned here until the next epoch: the consumer
         # borrows them from this actor (the owner), so dropping our handle
         # the moment it's dealt would race the borrower registration.
@@ -404,19 +440,52 @@ class _SplitCoordinator:
         # reentrant.
         self._lock = threading.Lock()
 
+    def _block_nodes(self, ref) -> set:
+        """Node ids currently holding this block (controller object
+        directory); empty for inline/small objects."""
+        from ray_tpu.core import api
+
+        try:
+            core = api._require_worker()
+            locs = core._run(
+                core.controller.call("lookup_object", {"oid": ref.id.binary()}),
+                timeout=5,
+            )
+            return {l["node_id"] for l in (locs or [])}
+        except Exception:
+            return set()
+
     def next_block(self, split_idx: int, epoch: int):
         with self._lock:
             if epoch > self.epoch:
                 self.epoch = epoch
                 self._dealt.clear()
+                self._ready.clear()
                 self.stream = StreamingExecutor(self.max_in_flight).execute(self.leaf)
-            if epoch < self.epoch or self.stream is None:
+            if epoch < self.epoch:
                 return None  # stale epoch: that consumer's epoch is over
-            try:
-                ref = next(self.stream)
-            except StopIteration:
-                self.stream = None
+            # Refill the look-ahead buffer; locations resolved ONCE per ref
+            # at append time (re-querying the controller per deal under the
+            # lock would serialize all consumers behind repeated RPCs).
+            want = self.max_in_flight if self.locality_hints else 1
+            while self.stream is not None and len(self._ready) < want:
+                try:
+                    ref = next(self.stream)
+                except StopIteration:
+                    self.stream = None
+                    break
+                nodes = self._block_nodes(ref) if self.locality_hints else set()
+                self._ready.append((ref, nodes))
+            if not self._ready:
                 return None
+            pick = 0
+            hint = self.locality_hints[split_idx] if self.locality_hints else None
+            if hint is not None:
+                for i, (_ref, nodes) in enumerate(self._ready):
+                    if hint in nodes:
+                        pick = i
+                        break
+            ref, _ = self._ready.pop(pick)
             self._dealt.append(ref)
             return ref
 

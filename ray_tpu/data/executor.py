@@ -59,8 +59,19 @@ def _read_fn_task(read_fn: Callable):
 
 
 class StreamingExecutor:
-    def __init__(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT):
+    def __init__(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
+                 budgets: Optional[dict] = None):
         self.max_in_flight = max_in_flight
+        # Per-op-kind in-flight budgets (reference: per-operator resource
+        # budgets in _internal/execution/resource_manager.py): an op kind in
+        # `budgets` caps ITS stage's concurrent tasks independently of the
+        # global default — e.g. {"map_batches": 2} throttles a memory-hungry
+        # UDF stage without starving reads.
+        self.budgets = dict(budgets or {})
+
+    def _budget(self, kinds) -> int:
+        vals = [self.budgets[k] for k in kinds if k in self.budgets]
+        return min(vals) if vals else self.max_in_flight
 
     # -- public ------------------------------------------------------------
     def execute(self, plan_leaf: LogicalOp) -> Iterator:
@@ -89,6 +100,8 @@ class StreamingExecutor:
                 )
             elif op.kind == "zip":
                 stream = self._zip(stream, op)
+            elif op.kind == "join":
+                stream = self._join(stream, op)
             else:
                 stream = self._all_to_all(stream, op)
         return self._mapped_stream(stream, seg)
@@ -118,10 +131,11 @@ class StreamingExecutor:
 
         ops = [(o.kind, o.fn, o.params) for o in seg]
         task = rt.remote(_apply_segment)
+        budget = self._budget([o.kind for o in seg])
         pending: list = []
         for ref in stream:
             pending.append(task.remote(ref, ops))
-            while len(pending) >= self.max_in_flight:
+            while len(pending) >= budget:
                 yield pending.pop(0)
         yield from pending
 
@@ -137,14 +151,71 @@ class StreamingExecutor:
             return
         if op.kind == "repartition":
             yield from self._repartition(refs, op.params["num_blocks"])
+        elif op.kind == "hash_repartition":
+            parts = self._hash_shuffle(refs, op.params["key"], op.params["num_blocks"])
+            concat = rt.remote(_concat_parts)
+            for plist in parts:
+                yield concat.remote(*plist)
         elif op.kind == "random_shuffle":
             yield from self._random_shuffle(refs, op.params.get("seed"))
         elif op.kind == "sort":
             yield from self._sort(refs, op.params["key"], op.params.get("descending", False))
         elif op.kind == "groupby_map":
             yield from self._groupby(refs, op.params["key"], op.fn)
+        elif op.kind == "hash_groupby":
+            key = op.params["key"]
+            n_parts = op.params.get("num_partitions") or min(8, len(refs))
+            parts = self._hash_shuffle(refs, key, n_parts)
+            reduce_task = rt.remote(_grouped_reduce)
+            for plist in parts:
+                yield reduce_task.remote(key, op.fn, *plist)
         else:
             raise ValueError(f"unknown all-to-all op {op.kind}")
+
+    def _hash_shuffle(self, refs: list, key: str, n_parts: int) -> list[list]:
+        """Map-side hash partition: one task per input block emits n_parts
+        sub-blocks as SEPARATE return objects (reference:
+        _internal/execution/operators/hash_shuffle.py — map tasks partition,
+        reduce tasks consume their column of the partition matrix). Returns
+        parts[p] = list of sub-block refs for partition p; data flows block
+        -> partition pieces -> reduce through the object store, never the
+        driver."""
+        import ray_tpu as rt
+
+        n_parts = max(1, n_parts)
+        budget = self._budget(["hash_partition"])
+        part_task = rt.remote(_hash_partition).options(num_returns=n_parts)
+        parts: list[list] = [[] for _ in range(n_parts)]
+        in_flight: list = []
+        for ref in refs:
+            out = part_task.remote(key, n_parts, ref)
+            out = [out] if n_parts == 1 else out
+            for p, r in enumerate(out):
+                parts[p].append(r)
+            in_flight.append(out[0])
+            if len(in_flight) >= budget:
+                rt.wait(in_flight, num_returns=1, timeout=300)
+                in_flight = in_flight[1:]
+        return parts
+
+    def _join(self, stream: Iterator, op: LogicalOp) -> Iterator:
+        """Hash join (reference: _internal/execution/operators/join.py):
+        both sides hash-partition on the key; one reduce task per partition
+        joins its pair of partitions."""
+        import ray_tpu as rt
+
+        on = op.params["on"]
+        how = op.params.get("how", "inner")
+        left = list(stream)
+        right = list(self._run_chain(op.inputs[1].chain_from_source()))
+        if not left or (not right and how == "inner"):
+            return
+        n_parts = op.params.get("num_partitions") or min(8, max(len(left), len(right), 1))
+        lparts = self._hash_shuffle(left, on, n_parts)
+        rparts = self._hash_shuffle(right, on, n_parts) if right else [[] for _ in range(n_parts)]
+        join_task = rt.remote(_join_parts)
+        for p in range(n_parts):
+            yield join_task.remote(on, how, len(lparts[p]), *(lparts[p] + rparts[p]))
 
     def _limit(self, refs: list, n: int) -> Iterator:
         import ray_tpu as rt
@@ -281,6 +352,88 @@ def _sort_all(key: str, descending: bool, *blocks):
     if descending:
         order = order[::-1]
     return B.block_take(merged, order)
+
+
+def _stable_partition_ids(values, n_parts: int) -> "np.ndarray":
+    """Deterministic cross-process partition assignment (Python's str hash is
+    per-process randomized; crc32 of repr is stable for the value types Arrow
+    columns hold). Numeric keys are canonicalized so equal values agree on a
+    partition across dtypes (an int64 1 and a float64 1.0 compare equal in
+    the reduce's dict — they must land in the same partition)."""
+    import zlib
+
+    arr = np.asarray(values)
+    if arr.dtype.kind in "iu":  # integers partition directly
+        return (arr % n_parts).astype(np.int64)
+    if arr.dtype.kind == "f":
+        as_int = arr.astype(np.int64, copy=False)
+        # Integral floats route like ints (cross-dtype join consistency);
+        # true fractional keys use the stable byte hash below.
+        with np.errstate(invalid="ignore"):
+            if np.all(np.isfinite(arr)) and np.all(as_int == arr):
+                return (as_int % n_parts).astype(np.int64)
+    def one(v):
+        if isinstance(v, (int, np.integer)):
+            return int(v) % n_parts
+        if isinstance(v, (float, np.floating)) and float(v).is_integer():
+            return int(v) % n_parts  # same route as the int fast path
+        return zlib.crc32(repr(v).encode()) % n_parts
+
+    return np.array([one(v) for v in values], np.int64)
+
+
+def _hash_partition(key: str, n_parts: int, blk):
+    """Map side of the shuffle: split one block into n_parts sub-blocks by
+    key hash (multi-return task: each sub-block is its own object)."""
+    if blk.num_rows == 0:
+        parts = [blk] * n_parts
+    else:
+        ids = _stable_partition_ids(blk.column(key).to_pylist(), n_parts)
+        parts = [B.block_take(blk, np.nonzero(ids == p)[0]) for p in range(n_parts)]
+    return parts[0] if n_parts == 1 else tuple(parts)
+
+
+def _concat_parts(*parts):
+    return B.concat_blocks([p for p in parts if p.num_rows] or list(parts[:1]))
+
+
+def _grouped_reduce(key: str, agg_fn, *parts):
+    """Reduce side of a hash groupby: every row of a key lives in exactly one
+    partition, so per-partition grouping is globally correct."""
+    return _groupby_all(key, agg_fn, *parts)
+
+
+def _join_parts(on: str, how: str, n_left: int, *parts):
+    """Per-partition hash join. Right-side non-key columns keep their names;
+    collisions with left get a _1 suffix (same convention as zip)."""
+    left = B.concat_blocks(list(parts[:n_left])) if n_left else B.block_from_rows([])
+    right = B.concat_blocks(list(parts[n_left:])) if len(parts) > n_left else B.block_from_rows([])
+    lrows = B.block_rows(left) if left.num_rows else []
+    rrows = B.block_rows(right) if right.num_rows else []
+    by_key: dict = {}
+    for r in rrows:
+        by_key.setdefault(r[on], []).append(r)
+    lcols = set(left.column_names) if left.num_rows else set()
+    # Uniform output schema: every row carries every joined column (an
+    # unmatched left row gets None for right columns) — blocks are columnar,
+    # so ragged row dicts would silently drop late-appearing columns.
+    rcols = [c for c in (right.column_names if right.num_rows else []) if c != on]
+    out_name = {c: (c + "_1" if c in lcols else c) for c in rcols}
+    out = []
+    for lr in lrows:
+        matches = by_key.get(lr[on])
+        if matches:
+            for rr in matches:
+                row = dict(lr)
+                for c in rcols:
+                    row[out_name[c]] = rr[c]
+                out.append(row)
+        elif how == "left":
+            row = dict(lr)
+            for c in rcols:
+                row[out_name[c]] = None
+            out.append(row)
+    return B.block_from_rows(out)
 
 
 def _groupby_all(key: str, agg_fn, *blocks):
