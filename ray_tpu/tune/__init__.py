@@ -20,6 +20,7 @@ from ray_tpu.tune.search import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.searcher import BasicVariantGenerator, Searcher, TPESearcher
 from ray_tpu.tune.tuner import (
     ResultGrid,
     TrialResult,
@@ -30,9 +31,12 @@ from ray_tpu.tune.tuner import (
 
 __all__ = [
     "ASHAScheduler",
+    "BasicVariantGenerator",
     "FIFOScheduler",
     "PopulationBasedTraining",
     "ResultGrid",
+    "Searcher",
+    "TPESearcher",
     "TrialResult",
     "TrialScheduler",
     "TuneConfig",

@@ -29,6 +29,10 @@ class TuneConfig:
     metric: Optional[str] = None
     mode: str = "max"
     scheduler: Optional[TrialScheduler] = None
+    # Pluggable search algorithm (ray_tpu.tune.TPESearcher etc.); None =
+    # grid/random variant generation from param_space (reference:
+    # tune_config.search_alg -> Searcher).
+    search_alg: Optional[Any] = None
     max_concurrent_trials: Optional[int] = None
     resources_per_trial: dict = dataclasses.field(default_factory=dict)
     seed: Optional[int] = None
@@ -141,16 +145,103 @@ class Trial:
 
 
 class TuneController:
-    """Drives all trials to completion (reference: tune_controller.py:68)."""
+    """Drives all trials to completion (reference: tune_controller.py:68).
+
+    With a `searcher`, trials are created DYNAMICALLY (suggest() as capacity
+    frees, so model-based searchers see completed results before proposing).
+    Sweep state (trial configs/states/metrics + searcher observations) is
+    checkpointed to `<storage>/tune_state.json` on every transition, so a
+    controller restart resumes the sweep: finished trials keep their
+    results, interrupted ones restart from their latest trial checkpoint
+    (reference: the controller's experiment-state snapshots + Tuner.restore).
+    """
 
     def __init__(self, trainable: Callable, trials: list[Trial],
-                 tune_config: TuneConfig, poll_interval_s: float = 0.1):
+                 tune_config: TuneConfig, poll_interval_s: float = 0.1,
+                 searcher=None, storage: Optional[str] = None):
         self.trainable = trainable
         self.trials = trials
         self.cfg = tune_config
         self.scheduler = tune_config.scheduler or FIFOScheduler()
+        self.searcher = searcher
+        self.storage = storage
         self.poll_interval_s = poll_interval_s
         self._by_id = {t.trial_id: t for t in trials}
+
+    # -- sweep-state persistence -------------------------------------------
+    def _state_file(self) -> Optional[str]:
+        return os.path.join(self.storage, "tune_state.json") if self.storage else None
+
+    def _save_sweep_state(self) -> None:
+        path = self._state_file()
+        if path is None:
+            return
+        import json
+
+        state = {
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "state": t.state,
+                    "iteration": t.iteration,
+                    "metrics": t.metrics,
+                    "metrics_history": t.metrics_history,
+                    "error": t.error,
+                    "path": t.path,
+                    "resources": t.resources,
+                }
+                for t in self.trials
+            ],
+            "searcher": self.searcher.get_state() if self.searcher else None,
+        }
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)
+        except (OSError, TypeError, ValueError):
+            traceback.print_exc()  # unserializable config: sweep runs, resume degraded
+
+    @staticmethod
+    def load_sweep_state(storage: str) -> Optional[dict]:
+        import json
+
+        try:
+            with open(os.path.join(storage, "tune_state.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _next_trial_id(self) -> str:
+        return f"trial_{len(self.trials):05d}"
+
+    def _maybe_create_trials(self, capacity_left: int) -> list[Trial]:
+        """Dynamic trial creation from the searcher, bounded by num_samples
+        and free capacity."""
+        created: list[Trial] = []
+        if self.searcher is None or self.storage is None:
+            return created
+        # Budget: num_samples, but a searcher carrying its OWN sample count
+        # (BasicVariantGenerator.total) must not be silently truncated by the
+        # config default of 1 — suggest()->None remains the hard stop.
+        budget = max(self.cfg.num_samples, getattr(self.searcher, "total", 0))
+        while capacity_left > 0 and len(self.trials) < budget:
+            tid = self._next_trial_id()
+            cfg = self.searcher.suggest(tid)
+            if cfg is None:
+                break
+            trial = Trial(
+                trial_id=tid,
+                config=cfg,
+                storage_path=os.path.join(self.storage, tid),
+                resources=dict(self.cfg.resources_per_trial),
+            )
+            self.trials.append(trial)
+            self._by_id[tid] = trial
+            created.append(trial)
+            capacity_left -= 1
+        return created
 
     # -- lifecycle ---------------------------------------------------------
     def _try_start(self, trial: Trial) -> bool:
@@ -202,29 +293,34 @@ class TuneController:
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> list[TrialResult]:
-        cap = self.cfg.max_concurrent_trials or len(self.trials)
+        cap = self.cfg.max_concurrent_trials or max(len(self.trials), 1)
+        self._save_sweep_state()
         while True:
             running = [t for t in self.trials if t.state == "RUNNING"]
             pending = [t for t in self.trials if t.state == "PENDING"]
+            pending += self._maybe_create_trials(cap - len(running) - len(pending))
             if not running and not pending:
-                break
+                break  # nothing active and the searcher offered nothing new
             for trial in pending:
                 if len(running) >= cap:
                     break
                 try:
                     if self._try_start(trial):
                         running.append(trial)
+                        self._save_sweep_state()
                     else:
                         break  # no capacity right now; retry next cycle
                 except Exception:
                     trial.error = traceback.format_exc()
                     trial.state = "ERRORED"
                     self._teardown(trial)
+                    self._save_sweep_state()
             made_progress = False
             for trial in list(running):
                 made_progress |= self._poll_trial(trial)
             if not made_progress:
                 time.sleep(self.poll_interval_s)
+        self._save_sweep_state()
         return [t.result() for t in self.trials]
 
     def _poll_trial(self, trial: Trial) -> bool:
@@ -246,13 +342,15 @@ class TuneController:
                     traceback.print_exc()
             trial.metrics = metrics
             trial.metrics_history.append(metrics)
+            if self.searcher is not None:
+                self.searcher.on_trial_result(trial.trial_id, metrics)
             d = self.scheduler.on_trial_result(trial, metrics)
             if d != CONTINUE:
                 decision = d
+        if progressed:
+            self._save_sweep_state()
         if decision == STOP:
-            self._teardown(trial)
-            trial.state = "TERMINATED"
-            self.scheduler.on_trial_complete(trial, trial.metrics)
+            self._complete(trial)
             return True
         if decision == PERTURB:
             self._apply_perturb(trial)
@@ -260,11 +358,17 @@ class TuneController:
         if status["error"]:
             return self._on_trial_failed(trial, status["error"])
         if status["finished"]:
-            self._teardown(trial)
-            trial.state = "TERMINATED"
-            self.scheduler.on_trial_complete(trial, trial.metrics)
+            self._complete(trial)
             return True
         return progressed
+
+    def _complete(self, trial: Trial) -> None:
+        self._teardown(trial)
+        trial.state = "TERMINATED"
+        self.scheduler.on_trial_complete(trial, trial.metrics)
+        if self.searcher is not None:
+            self.searcher.on_trial_complete(trial.trial_id, trial.metrics)
+        self._save_sweep_state()
 
     def _on_trial_failed(self, trial: Trial, err: str) -> bool:
         self._teardown(trial)
@@ -273,42 +377,88 @@ class TuneController:
             trial.error = err
             trial.state = "ERRORED"
             self.scheduler.on_trial_complete(trial, None)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, None)
         else:
             resume = trial.ckpt_manager.latest
             trial.resume_path = resume.path if resume else None
             trial.state = "PENDING"
+        self._save_sweep_state()
         return True
 
 
 class Tuner:
-    """Public API (reference: tune/tuner.py Tuner.fit -> ResultGrid)."""
+    """Public API (reference: tune/tuner.py Tuner.fit -> ResultGrid).
+
+    ``resume=True`` restores a sweep from ``<storage>/tune_state.json``
+    (reference: Tuner.restore): TERMINATED/ERRORED trials keep their
+    recorded results without re-running; interrupted trials restart from
+    their latest checkpoint; the searcher's observations are restored so
+    model-based search continues where it stopped."""
 
     def __init__(self, trainable: Callable, *, param_space: dict,
                  tune_config: Optional[TuneConfig] = None,
-                 run_config=None):
+                 run_config=None, resume: bool = False):
         from ray_tpu.train.config import RunConfig
 
         self.trainable = trainable
         self.param_space = param_space
         self.cfg = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig(name="tune_run")
+        self.resume = resume
+
+    def _restored_trials(self, storage: str) -> Optional[list[Trial]]:
+        state = TuneController.load_sweep_state(storage)
+        if state is None:
+            return None
+        trials: list[Trial] = []
+        for ts in state["trials"]:
+            t = Trial(ts["trial_id"], ts["config"], ts["path"],
+                      dict(ts.get("resources", {})))
+            t.iteration = ts.get("iteration", 0)
+            t.metrics = ts.get("metrics", {})
+            t.metrics_history = ts.get("metrics_history", [])
+            t.error = ts.get("error")
+            if ts["state"] in ("TERMINATED", "ERRORED"):
+                t.state = ts["state"]
+            else:
+                # Interrupted mid-flight: restart from the latest trial
+                # checkpoint (the per-trial CheckpointManager reloads its
+                # own persisted index).
+                resume = t.ckpt_manager.latest
+                t.resume_path = resume.path if resume else None
+                t.state = "PENDING"
+            trials.append(t)
+        if self.cfg.search_alg is not None and state.get("searcher") is not None:
+            self.cfg.search_alg.set_state(state["searcher"])
+        return trials
 
     def fit(self) -> ResultGrid:
         if not rt.is_initialized():
             rt.init()
         storage = self.run_config.resolved_storage_path()
-        configs = generate_variants(
-            self.param_space, self.cfg.num_samples, self.cfg.seed
+        trials: Optional[list[Trial]] = None
+        if self.resume:
+            trials = self._restored_trials(storage)
+        if trials is None:
+            if self.cfg.search_alg is not None:
+                trials = []  # created dynamically by the controller
+            else:
+                configs = generate_variants(
+                    self.param_space, self.cfg.num_samples, self.cfg.seed
+                )
+                trials = [
+                    Trial(
+                        trial_id=f"trial_{i:05d}",
+                        config=cfg,
+                        storage_path=os.path.join(storage, f"trial_{i:05d}"),
+                        resources=dict(self.cfg.resources_per_trial),
+                    )
+                    for i, cfg in enumerate(configs)
+                ]
+        controller = TuneController(
+            self.trainable, trials, self.cfg,
+            searcher=self.cfg.search_alg, storage=storage,
         )
-        trials = [
-            Trial(
-                trial_id=f"trial_{i:05d}",
-                config=cfg,
-                storage_path=os.path.join(storage, f"trial_{i:05d}"),
-                resources=dict(self.cfg.resources_per_trial),
-            )
-            for i, cfg in enumerate(configs)
-        ]
-        controller = TuneController(self.trainable, trials, self.cfg)
         results = controller.run()
         return ResultGrid(results, self.cfg.metric, self.cfg.mode)
