@@ -14,20 +14,72 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 _INDEX = """<!doctype html><title>ray_tpu dashboard</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:2em;max-width:70em}
+table{border-collapse:collapse;margin:0.6em 0}
+td,th{border:1px solid #ccc;padding:0.25em 0.7em;text-align:left;font-size:0.92em}
+h3{margin-bottom:0.1em}.muted{color:#777;font-size:0.85em}
+</style>
 <h2>ray_tpu cluster</h2>
-<ul>
-<li><a href=/api/cluster>/api/cluster</a> — nodes, actors, PGs, jobs</li>
-<li><a href=/api/events>/api/events</a> — structured event log</li>
-<li><a href=/api/metrics>/api/metrics</a> — aggregated metrics (JSON)</li>
-<li><a href=/api/jobs>/api/jobs</a> — submitted jobs</li>
-<li><a href=/metrics>/metrics</a> — Prometheus exposition</li>
-</ul>"""
+<div class=muted>auto-refreshes every 3s —
+<a href=/api/cluster>cluster</a> · <a href=/api/events>events</a> ·
+<a href=/api/metrics>metrics</a> · <a href=/api/jobs>jobs</a> ·
+<a href=/metrics>prometheus</a> ·
+profile a worker: <code>/api/profile?addr=IP:PORT&duration=2</code></div>
+<h3>Nodes</h3><table id=nodes></table>
+<h3>Actors</h3><table id=actors></table>
+<h3>Placement groups</h3><table id=pgs></table>
+<script>
+function row(cells, tag){return '<tr>'+cells.map(c=>'<'+tag+'>'+c+'</'+tag+'>').join('')+'</tr>'}
+async function refresh(){
+  try{
+    const s = await (await fetch('/api/cluster')).json();
+    const nodes = s.nodes||{};
+    document.getElementById('nodes').innerHTML =
+      row(['node','state','resources (avail/total)','labels'],'th') +
+      Object.entries(nodes).map(([id,n])=>row([id.slice(0,12),
+        n.state + (n.draining?' (draining)':''),
+        Object.keys(n.resources_total||{}).map(k=>k+': '+(n.resources_available[k]??0)+'/'+n.resources_total[k]).join('<br>'),
+        Object.entries(n.labels||{}).map(([k,v])=>k+'='+v).join('<br>')],'td')).join('');
+    const actors = s.actors||{};
+    document.getElementById('actors').innerHTML =
+      row(['actor','name','state','node','worker addr'],'th') +
+      Object.entries(actors).map(([id,a])=>row([id.slice(0,12), a.name||'',
+        a.state, (a.node_id||'').slice(0,12), a.worker_addr||''],'td')).join('');
+    const pgs = s.placement_groups||{};
+    document.getElementById('pgs').innerHTML =
+      row(['pg','state','bundles'],'th') +
+      Object.entries(pgs).map(([id,p])=>row([id.slice(0,12), p.state,
+        (p.bundles||[]).length],'td')).join('');
+  }catch(e){}
+}
+refresh(); setInterval(refresh, 3000);
+</script>"""
 
 
 def _payload(path: str):
     from ray_tpu.core import api
 
     core = api._require_worker()
+    if path.startswith("/api/profile"):
+        # On-demand CPU profile of a running worker (reference: dashboard
+        # reporter's py-spy endpoint, profile_manager.py:60-100): dial the
+        # worker and sample its threads.
+        from urllib.parse import parse_qs, urlsplit
+
+        q = parse_qs(urlsplit(path).query)
+        addr = (q.get("addr") or [""])[0]
+        if not addr:
+            return {"error": "pass ?addr=IP:PORT (see /api/cluster actors)"}
+        duration = float((q.get("duration") or ["2.0"])[0])
+
+        async def profile():
+            conn = await core._peer_conn(addr)
+            return await conn.call(
+                "profile_cpu", {"duration_s": duration}, timeout=duration + 30
+            )
+
+        return core._run(profile())
     if path == "/api/cluster":
         return core._run(core.controller.call("get_cluster_state", {}))
     if path == "/api/events":

@@ -46,6 +46,7 @@ class _JobSupervisor:
         )
         self._status = JobStatus.RUNNING
         self._put_status()
+        self._done = threading.Event()
         self._waiter = threading.Thread(target=self._wait, daemon=True)
         self._waiter.start()
 
@@ -60,6 +61,14 @@ class _JobSupervisor:
             self._status = JobStatus.FAILED
             self._message = f"entrypoint exited with code {rc}"
         self._put_status()
+        self._done.set()
+
+    def wait_finished(self, timeout_s: float = 300.0) -> str:
+        """Server-side blocking wait (event-driven: set the moment the
+        entrypoint exits) — clients make ONE call instead of polling status.
+        Needs its own actor lane (the supervisor runs max_concurrency > 1)."""
+        self._done.wait(timeout=timeout_s)
+        return self._status
 
     def _put_status(self):
         from ray_tpu.core import api
@@ -118,7 +127,8 @@ class JobSubmissionClient:
         job_id = job_id or f"raytpu-job-{os.urandom(4).hex()}"
         log_path = os.path.join(self.log_dir, f"{job_id}.log")
         sup = rt.remote(_JobSupervisor).options(
-            name=f"__job_supervisor:{job_id}", namespace=JOB_NS, lifetime="detached"
+            name=f"__job_supervisor:{job_id}", namespace=JOB_NS, lifetime="detached",
+            max_concurrency=4,  # wait_finished blocks a lane; status/logs keep flowing
         ).remote(job_id, entrypoint, env, log_path, core.controller_addr)
         # Surface constructor failures synchronously.
         rt.get(sup.status.remote(), timeout=60)
@@ -177,10 +187,20 @@ class JobSubmissionClient:
         return rt.get(sup.stop.remote(), timeout=30)
 
     def wait_until_finished(self, job_id: str, timeout_s: float = 300.0) -> str:
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        import ray_tpu as rt
+
+        try:
+            # Event-driven: ONE blocking call on the supervisor (set the
+            # moment the entrypoint exits) instead of client-side polling.
+            sup = rt.get_actor(f"__job_supervisor:{job_id}", namespace=JOB_NS)
+            status = rt.get(sup.wait_finished.remote(timeout_s), timeout=timeout_s + 30)
+            if status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+                return status
+        except Exception:
+            # Supervisor gone or died mid-wait (its job may still have
+            # FINISHED — _put_status lands before exit): the terminal state
+            # lives in the KV.
             status = self.get_job_status(job_id)
             if status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
                 return status
-            time.sleep(0.25)
         raise TimeoutError(f"job {job_id} not finished after {timeout_s}s")

@@ -11,6 +11,7 @@ from ray_tpu.serve.api import (
     get_app_handle,
     get_deployment_handle,
     http_port,
+    rpc_port,
     run,
     shutdown,
     start,
@@ -24,6 +25,7 @@ from ray_tpu.serve.handle import (
     DeploymentResponse,
     DeploymentResponseGenerator,
 )
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import Request
 
 __all__ = [
@@ -40,7 +42,10 @@ __all__ = [
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
     "http_port",
+    "multiplexed",
+    "rpc_port",
     "run",
     "shutdown",
     "start",

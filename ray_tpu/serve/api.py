@@ -108,6 +108,7 @@ def run(
                 "config": {
                     "initial_replicas": cfg.initial_replicas(),
                     "max_ongoing_requests": cfg.max_ongoing_requests,
+                    "startup_timeout_s": cfg.startup_timeout_s,
                     "autoscaling_config": (
                         {
                             "min_replicas": auto.min_replicas,
@@ -134,14 +135,14 @@ def run(
 
 
 def _wait_healthy(ctl, app_name: str, timeout_s: float):
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
+    # ONE blocking call: the controller notifies its waiters on every state
+    # change (no client-side polling; reference: long-poll updates).
+    ok = rt.get(
+        ctl.wait_app_healthy.remote(app_name, timeout_s), timeout=timeout_s + 30
+    )
+    if not ok:
         status = rt.get(ctl.get_status.remote(), timeout=30)
-        deps = status["apps"].get(app_name, {})
-        if deps and all(d["status"] == "HEALTHY" for d in deps.values()):
-            return
-        time.sleep(0.1)
-    raise TimeoutError(f"app {app_name!r} not HEALTHY within {timeout_s}s: {status}")
+        raise TimeoutError(f"app {app_name!r} not HEALTHY within {timeout_s}s: {status}")
 
 
 def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
@@ -168,6 +169,12 @@ def http_port() -> int:
     if port is None:
         raise RuntimeError("HTTP proxy not started")
     return port
+
+
+def rpc_port() -> int:
+    """Binary RPC ingress port (the gRPC-proxy equivalent)."""
+    proxy = rt.get_actor("__serve_proxy__", namespace=SERVE_NAMESPACE)
+    return rt.get(proxy.get_rpc_port.remote(), timeout=10)
 
 
 def delete(app_name: str = "default"):

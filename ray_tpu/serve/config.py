@@ -39,6 +39,10 @@ class DeploymentConfig:
     user_config: Any = None
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
     health_check_period_s: float = 2.0
+    # Replica construction budget: model replicas that compile during init
+    # (LLM warmup on TPU) legitimately take minutes (reference:
+    # DEFAULT_HEALTH_CHECK_TIMEOUT plus its initial-deadline handling).
+    startup_timeout_s: float = 600.0
     graceful_shutdown_timeout_s: float = 5.0
 
     def initial_replicas(self) -> int:

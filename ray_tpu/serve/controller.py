@@ -78,6 +78,10 @@ class ServeController:
         self.routes: dict[str, tuple[str, str]] = {}  # prefix -> (app, deployment)
         self.http_port: Optional[int] = None
         self._stop = threading.Event()
+        # Notified after every state-changing reconcile pass: server-side
+        # blocking waits (wait_app_healthy) ride this instead of clients
+        # polling get_status (reference: LongPollHost).
+        self._state_changed = threading.Condition(self.lock)
         self._restore()
         self._thread = threading.Thread(target=self._control_loop, name="serve-ctl", daemon=True)
         self._thread.start()
@@ -184,6 +188,23 @@ class ServeController:
     def ping(self) -> bool:
         return True
 
+    def wait_app_healthy(self, app_name: str, timeout_s: float = 60.0) -> bool:
+        """Block (server-side, event-driven) until every deployment of the
+        app is HEALTHY — replaces client-side status polling (the reference's
+        long-poll pattern, long_poll.py: clients wait on the controller, the
+        controller notifies on state change). Runs on its own actor lane
+        (max_concurrency > 1), so the control loop keeps reconciling."""
+        deadline = time.time() + timeout_s
+        while True:
+            with self._state_changed:
+                deps = self.apps.get(app_name, {})
+                if deps and all(d.status == "HEALTHY" for d in deps.values()):
+                    return True
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._state_changed.wait(timeout=min(remaining, 2.0))
+
     # -- control loop ------------------------------------------------------
     def _control_loop(self):
         import ray_tpu as rt  # noqa: F401  (ensures API ready in this process)
@@ -203,6 +224,8 @@ class ServeController:
                         changed |= self._health_check(dep)
                 if changed:
                     self._checkpoint()
+                    with self._state_changed:
+                        self._state_changed.notify_all()
             except Exception:
                 traceback.print_exc()
             self._stop.wait(0.1)
@@ -273,8 +296,12 @@ class ServeController:
                 .remote(dep.app, dep.name, rid, callable_, args, kwargs, user_config)
             )
             # Block until constructed so routing info only advertises live
-            # replicas (reference waits for replica init too).
-            rt.get(handle.check_health.remote(), timeout=60)
+            # replicas (reference waits for replica init too). Init may
+            # legitimately take minutes (LLM warmup compiles on TPU).
+            rt.get(
+                handle.check_health.remote(),
+                timeout=float(cfg.get("startup_timeout_s", 600.0)),
+            )
         except Exception:
             traceback.print_exc()
             return None

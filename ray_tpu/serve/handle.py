@@ -66,6 +66,9 @@ class _ReplicaSet:
         self.version = -1
         self.fetched_at = 0.0
         self.queued = 0
+        # Multiplexing: model id -> replica that last served it (sticky
+        # routing keeps a model's requests on the replica that loaded it).
+        self.model_affinity: dict[str, str] = {}
         self._closed = False
         self._refreshing = False
         self._outstanding: list[tuple[Any, str]] = []  # (ref, replica_name)
@@ -115,7 +118,7 @@ class _ReplicaSet:
                 self.cond.notify_all()
 
     # -- routing -----------------------------------------------------------
-    def _admit(self, timeout_s: float):
+    def _admit(self, timeout_s: float, model_id: str = ""):
         """Block until some replica has capacity; returns (name, handle) with
         the ongoing count already incremented."""
         deadline = time.time() + timeout_s
@@ -128,7 +131,7 @@ class _ReplicaSet:
                 except Exception:
                     pass  # transient controller hiccup: retry until deadline
                 with self.cond:
-                    name = self._pick_locked()
+                    name = self._pick_locked(model_id)
                     if name is not None:
                         self.ongoing[name] = self.ongoing.get(name, 0) + 1
                         return name, self.replicas[name]
@@ -150,11 +153,16 @@ class _ReplicaSet:
             self.ongoing[name] = max(0, self.ongoing.get(name, 1) - 1)
             self.cond.notify_all()
 
-    def route(self, method: str, args: tuple, kwargs: dict, timeout_s: float = 60.0):
-        """Pick a replica (pow-2 choices), submit, return (ref, name)."""
-        name, replica = self._admit(timeout_s)
+    def route(self, method: str, args: tuple, kwargs: dict, timeout_s: float = 60.0,
+              model_id: str = ""):
+        """Pick a replica (pow-2 choices; model-affine when a multiplexed
+        model id is set), submit, return (ref, name)."""
+        name, replica = self._admit(timeout_s, model_id=model_id)
         try:
-            ref = replica.handle_request.remote(method, args, kwargs)
+            if model_id:
+                ref = replica.handle_request.remote(method, args, kwargs, model_id)
+            else:
+                ref = replica.handle_request.remote(method, args, kwargs)
         except Exception:
             self._release(name)
             with self.cond:
@@ -163,19 +171,26 @@ class _ReplicaSet:
         with self.cond:
             self._outstanding.append((ref, name))
             self._ensure_threads()
+            self.cond.notify_all()  # wake the drainer (event-driven wait)
         return ref, name
 
     def route_streaming(self, method: str, args: tuple, kwargs: dict,
-                        timeout_s: float = 60.0, proxy: bool = False):
+                        timeout_s: float = 60.0, proxy: bool = False,
+                        model_id: str = ""):
         """Streaming variant: returns (ObjectRefGenerator, name). The ongoing
         count is held until the caller exhausts/closes the stream and calls
         _release(name) (DeploymentResponseGenerator owns that)."""
-        name, replica = self._admit(timeout_s)
+        name, replica = self._admit(timeout_s, model_id=model_id)
         actor_method = (
             replica.handle_request_proxy if proxy else replica.handle_request_streaming
         )
         try:
-            gen = actor_method.options(num_returns="streaming").remote(method, args, kwargs)
+            if model_id:
+                gen = actor_method.options(num_returns="streaming").remote(
+                    method, args, kwargs, model_id
+                )
+            else:
+                gen = actor_method.options(num_returns="streaming").remote(method, args, kwargs)
         except Exception:
             self._release(name)
             with self.cond:
@@ -185,14 +200,26 @@ class _ReplicaSet:
             self._ensure_threads()  # demand pusher must see streaming load too
         return gen, name
 
-    def _pick_locked(self) -> Optional[str]:
+    def _pick_locked(self, model_id: str = "") -> Optional[str]:
         live = [n for n in self.replicas if self.ongoing.get(n, 0) < self.max_ongoing]
         if not live:
             return None
+        if model_id:
+            # Model affinity (reference: multiplex-aware router): the replica
+            # that last served this model already holds it loaded — reuse it
+            # while it has capacity; otherwise fall through to pow-2 and
+            # re-pin the affinity to the new pick.
+            sticky = self.model_affinity.get(model_id)
+            if sticky in live:
+                return sticky
         if len(live) == 1:
-            return live[0]
-        a, b = random.sample(live, 2)
-        return a if self.ongoing.get(a, 0) <= self.ongoing.get(b, 0) else b
+            pick = live[0]
+        else:
+            a, b = random.sample(live, 2)
+            pick = a if self.ongoing.get(a, 0) <= self.ongoing.get(b, 0) else b
+        if model_id:
+            self.model_affinity[model_id] = pick
+        return pick
 
     def fail_over(self, name: str):
         """A request observed this replica dead: force membership refresh."""
@@ -221,15 +248,22 @@ class _ReplicaSet:
         while not self._closed:
             with self.cond:
                 pending = list(self._outstanding)
-            if not pending:
-                if time.time() - idle_since > 10.0:
-                    return  # thread parks; recreated on next route()
-                time.sleep(0.01)
-                continue
+                if not pending:
+                    if time.time() - idle_since > 10.0:
+                        return  # thread parks; recreated on next route()
+                    # Event-driven: route() notifies under this condition
+                    # when it appends an outstanding request.
+                    self.cond.wait(timeout=1.0)
+                    continue
             idle_since = time.time()
             refs = [r for r, _ in pending]
             try:
-                ready, _ = rt.wait(refs, num_returns=len(refs), timeout=0.05)
+                # Block until SOMETHING completes (event-driven in the core:
+                # rt.wait parks on ready events, no client-side polling).
+                ready, _ = rt.wait(refs, num_returns=1, timeout=1.0)
+                if ready:
+                    # Sweep everything already done in the same pass.
+                    ready, _ = rt.wait(refs, num_returns=len(refs), timeout=0)
             except Exception:
                 ready = refs  # core shut down: release everything
             if not ready:
@@ -271,12 +305,14 @@ class DeploymentResponse:
     """Future-like result of handle.remote() (reference: handle.py
     DeploymentResponse). `result()` retries once on replica death."""
 
-    def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict):
+    def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict,
+                 model_id: str = ""):
         self._rs = rs
         self._method = method
         self._args = args
         self._kwargs = kwargs
-        self._ref, self._idx = rs.route(method, args, kwargs)
+        self._model_id = model_id
+        self._ref, self._idx = rs.route(method, args, kwargs, model_id=model_id)
 
     def result(self, timeout: float | None = 60.0):
         import ray_tpu as rt
@@ -289,7 +325,9 @@ class DeploymentResponse:
                 self._rs.fail_over(self._idx)
                 if attempt == 2:
                     raise
-                self._ref, self._idx = self._rs.route(self._method, self._args, self._kwargs)
+                self._ref, self._idx = self._rs.route(
+                    self._method, self._args, self._kwargs, model_id=self._model_id
+                )
 
     def _to_object_ref(self):
         return self._ref
@@ -302,10 +340,12 @@ class DeploymentResponseGenerator:
     is exhausted, errors, or is closed."""
 
     def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict,
-                 proxy: bool = False):
+                 proxy: bool = False, model_id: str = ""):
         self._rs = rs
         self._released = False
-        self._gen, self._name = rs.route_streaming(method, args, kwargs, proxy=proxy)
+        self._gen, self._name = rs.route_streaming(
+            method, args, kwargs, proxy=proxy, model_id=model_id
+        )
 
     def __iter__(self):
         return self
@@ -353,33 +393,42 @@ class DeploymentHandle:
     destination process, so it can be shipped as a bind() init arg)."""
 
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 method_name: str = "__call__", stream: bool = False):
+                 method_name: str = "__call__", stream: bool = False,
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
         self.stream = stream
+        self.multiplexed_model_id = multiplexed_model_id
 
-    def options(self, method_name: Optional[str] = None, stream: Optional[bool] = None) -> "DeploymentHandle":
+    def options(self, method_name: Optional[str] = None, stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name,
             self.app_name,
             self.method_name if method_name is None else method_name,
             self.stream if stream is None else stream,
+            self.multiplexed_model_id if multiplexed_model_id is None else multiplexed_model_id,
         )
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.deployment_name, self.app_name, name, self.stream)
+        return DeploymentHandle(self.deployment_name, self.app_name, name,
+                                self.stream, self.multiplexed_model_id)
 
     def remote(self, *args, **kwargs):
         rs = _replica_set(self.app_name, self.deployment_name)
         if self.stream:
-            return DeploymentResponseGenerator(rs, self.method_name, args, kwargs)
-        return DeploymentResponse(rs, self.method_name, args, kwargs)
+            return DeploymentResponseGenerator(rs, self.method_name, args, kwargs,
+                                               model_id=self.multiplexed_model_id)
+        return DeploymentResponse(rs, self.method_name, args, kwargs,
+                                  model_id=self.multiplexed_model_id)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.app_name, self.method_name, self.stream))
+        return (DeploymentHandle, (self.deployment_name, self.app_name,
+                                   self.method_name, self.stream,
+                                   self.multiplexed_model_id))
 
     def __repr__(self):
         return f"DeploymentHandle({self.app_name}/{self.deployment_name}.{self.method_name})"

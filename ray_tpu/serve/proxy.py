@@ -60,12 +60,21 @@ def _free_port() -> int:
 
 
 class ProxyActor:
-    """One per serving node (tests run one). Owns port + route cache."""
+    """One per serving node (tests run one). Owns port + route cache.
+
+    Two ingress protocols (reference: HTTPProxy proxy.py:710 + gRPCProxy
+    proxy.py:534): HTTP/1.1 on `port`, and a length-prefixed binary RPC
+    protocol on `rpc_port` — frame = 4-byte LE length + pickled
+    (app, deployment, method, args, kwargs); reply = 4-byte LE length +
+    pickled ("ok", result) | ("err", message). The binary path skips HTTP
+    parsing and JSON for structured in-datacenter callers, which is the
+    role gRPC ingress plays in the reference."""
 
     ROUTE_TTL_S = 1.0
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, rpc_port: int = 0):
         self.port = port or _free_port()
+        self.rpc_port = rpc_port or _free_port()
         self._routes: dict[str, tuple[str, str]] = {}
         self._routes_at = 0.0
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=32, thread_name_prefix="proxy")
@@ -79,6 +88,9 @@ class ProxyActor:
     def get_port(self) -> int:
         return self.port
 
+    def get_rpc_port(self) -> int:
+        return self.rpc_port
+
     def check_health(self) -> bool:
         return self._thread.is_alive()
 
@@ -88,14 +100,64 @@ class ProxyActor:
 
         async def start():
             server = await asyncio.start_server(self._handle_conn, "127.0.0.1", self.port)
+            rpc_server = await asyncio.start_server(
+                self._handle_rpc_conn, "127.0.0.1", self.rpc_port
+            )
             self._ready.set()
-            async with server:
-                await server.serve_forever()
+            async with server, rpc_server:
+                await asyncio.gather(server.serve_forever(), rpc_server.serve_forever())
 
         try:
             self._loop.run_until_complete(start())
         except Exception:
             traceback.print_exc()
+
+    # -- binary RPC ingress -------------------------------------------------
+    async def _handle_rpc_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        import pickle
+
+        from ray_tpu.core import rpc as _rpc
+
+        authed = bool(_rpc.get_auth_token())
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                n = int.from_bytes(hdr, "little")
+                if n > 64 * 1024 * 1024:
+                    return
+                frame = await reader.readexactly(n)
+                if authed:
+                    # Frames carry the session HMAC tag (rpc.frame_tag):
+                    # unauthenticated bytes NEVER reach pickle.loads — the
+                    # same contract as core RPC (rpc.py per-frame auth).
+                    tag, frame = frame[:_rpc.FRAME_TAG_LEN], frame[_rpc.FRAME_TAG_LEN:]
+                    if not _rpc.frame_verify(tag, frame):
+                        return  # drop the unauthenticated peer
+
+                def run(frame=frame):
+                    from ray_tpu.serve.handle import DeploymentHandle
+
+                    try:
+                        app, deployment, method, args, kwargs = pickle.loads(frame)
+                        handle = DeploymentHandle(deployment, app, method or "__call__")
+                        result = handle.remote(*args, **kwargs).result(timeout=60)
+                        return pickle.dumps(("ok", result), protocol=5)
+                    except Exception as e:  # noqa: BLE001 — serialized to the client
+                        return pickle.dumps(("err", f"{type(e).__name__}: {e}"), protocol=5)
+
+                reply = await self._loop.run_in_executor(self._pool, run)
+                reply = _rpc.frame_tag(reply) + reply if authed else reply
+                writer.write(len(reply).to_bytes(4, "little") + reply)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            traceback.print_exc()
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:

@@ -56,26 +56,37 @@ class Replica:
             return self._instance
         return getattr(self._instance, method or "__call__")
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict):
+    def handle_request(self, method: str, args: tuple, kwargs: dict, model_id: str = ""):
+        from ray_tpu.serve.multiplex import _set_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _set_model_id(model_id) if model_id else None
         try:
             return self._resolve_fn(method)(*args, **kwargs)
         finally:
+            if token is not None:
+                from ray_tpu.serve.multiplex import _model_id_ctx
+
+                _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
-    def handle_request_streaming(self, method: str, args: tuple, kwargs: dict):
+    def handle_request_streaming(self, method: str, args: tuple, kwargs: dict,
+                                 model_id: str = ""):
         """Streaming call path: the user callable must return a generator;
         each yielded item ships to the caller as its own streamed return
         (reference: replica.py streaming generator user code riding
         ReportGeneratorItemReturns). Invoked with num_returns='streaming'."""
         import inspect
 
+        from ray_tpu.serve.multiplex import _model_id_ctx, _set_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _set_model_id(model_id) if model_id else None
         try:
             out = self._resolve_fn(method)(*args, **kwargs)
             if not inspect.isgenerator(out) and not hasattr(out, "__next__"):
@@ -85,19 +96,25 @@ class Replica:
                 )
             yield from out
         finally:
+            if token is not None:
+                _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
-    def handle_request_proxy(self, method: str, args: tuple, kwargs: dict):
+    def handle_request_proxy(self, method: str, args: tuple, kwargs: dict,
+                             model_id: str = ""):
         """HTTP-proxy call path: always streamed on the wire, tagged so the
         proxy can choose a buffered response for plain results and chunked
         transfer for generator results without knowing the deployment's shape
         up front. Yields ('value', x) once, or ('chunk', x) per item."""
         import inspect
 
+        from ray_tpu.serve.multiplex import _model_id_ctx, _set_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _set_model_id(model_id) if model_id else None
         try:
             out = self._resolve_fn(method)(*args, **kwargs)
             if inspect.isgenerator(out) or (
@@ -108,6 +125,8 @@ class Replica:
             else:
                 yield ("value", out)
         finally:
+            if token is not None:
+                _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
