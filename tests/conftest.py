@@ -41,3 +41,26 @@ def fresh_cluster():
     cluster = Cluster(initialize_head=False)
     yield cluster
     cluster.shutdown()
+
+
+# Per-test timeout (reference: pytest.ini's 180s default): one hung
+# collective/RPC must not eat the whole suite. SIGALRM-based (no
+# pytest-timeout in this image); generous default because CartPole learning
+# tests legitimately run minutes on this 1-core host.
+import signal
+
+TEST_TIMEOUT_S = int(os.environ.get("RAYTPU_TEST_TIMEOUT_S", "600"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    def _handler(signum, frame):
+        raise TimeoutError(f"test exceeded {TEST_TIMEOUT_S}s timeout")
+
+    old = signal.signal(signal.SIGALRM, _handler)
+    signal.alarm(TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
