@@ -11,11 +11,12 @@ Wire format: 8-byte little-endian length, then [16-byte session tag when a
 token is set] + pickle of (kind, msg_id, method_or_status, payload).
 kind: 0=request, 1=reply, 2=notify (no reply expected).
 
-Authentication (OPT-IN): pickle-over-TCP executes arbitrary code on
-unpickle, so when a session token is installed (``set_auth_token`` — set
-``Config.auth_token`` / ``RAYTPU_AUTH_TOKEN`` before cluster start; it
-propagates to daemons/workers/jobs via config+env), EVERY frame carries a
-16-byte HMAC of its payload keyed by the token, verified constant-time
+Authentication (ON BY DEFAULT): pickle-over-TCP executes arbitrary code on
+unpickle, so a session token is installed for every cluster (auto-minted at
+head start unless RAYTPU_AUTO_TOKEN=0; pin one with ``Config.auth_token`` /
+``RAYTPU_AUTH_TOKEN`` for multi-host; it propagates to daemons/workers/jobs
+via config+env). With a token installed, EVERY frame carries a
+16-byte keyed-BLAKE2b MAC of its payload, verified constant-time
 BEFORE the payload is unpickled. Frames from peers without the token (or
 tampered frames) are dropped and the connection closed — their bytes never
 reach pickle (reference: token auth, src/ray/rpc/authentication). Stateless
@@ -50,8 +51,11 @@ _frame_key: bytes = b""  # empty = auth disabled
 
 
 def set_auth_token(token: str | bytes | None):
-    """Install the session token for this process. Every frame sent gets an
-    HMAC(token, payload) tag prepended; every frame received must verify."""
+    """Install the session token for this process. Every frame sent gets a
+    keyed-BLAKE2b(token, payload) tag prepended; every frame received must
+    verify. All peers of a session must run the same build (the tag
+    algorithm is part of the wire format; there is no version negotiation —
+    a mismatched peer is dropped as unauthenticated)."""
     global _frame_key
     if not token:
         _frame_key = b""
@@ -65,13 +69,16 @@ def get_auth_token() -> bytes:
 
 
 def _tag(payload: bytes) -> bytes:
-    return hmac.new(_frame_key, payload, hashlib.sha256).digest()[:_TAG_LEN]
+    # Keyed BLAKE2b (a PRF by construction — no HMAC wrapper needed): ~2x
+    # faster than HMAC-SHA256 on the small frames the actor hot path sends,
+    # and this tag is computed 4x per call (send+verify on both ends).
+    return hashlib.blake2b(payload, key=_frame_key, digest_size=_TAG_LEN).digest()
 
 
 def frame_tag(payload: bytes) -> bytes:
     """Public tag helper for auxiliary authenticated protocols (e.g. the
-    serve proxy's binary ingress): HMAC(session key, payload) prefix, or
-    b"" when auth is disabled. Verify with frame_verify."""
+    serve proxy's binary ingress): keyed-BLAKE2b(session key, payload)
+    prefix, or b"" when auth is disabled. Verify with frame_verify."""
     return _tag(payload) if _frame_key else b""
 
 
@@ -176,7 +183,7 @@ class Connection:
                     return
                 data = await self.reader.readexactly(ln)
                 if _frame_key:
-                    # Constant-time per-frame HMAC check BEFORE any
+                    # Constant-time per-frame MAC check BEFORE any
                     # unpickling; wrong/missing tag = unauthenticated or
                     # tampered frame, drop the peer.
                     body = memoryview(data)[_TAG_LEN:]

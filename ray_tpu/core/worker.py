@@ -339,7 +339,9 @@ class CoreWorker:
         # Generous margin over the dial timeout: on a loaded single-core host
         # (CI running a full cluster per test module) registration RPCs can
         # take several seconds of scheduler delay without anything being wrong.
-        if not ready.wait(self.config.rpc_connect_timeout_s + 30):
+        # Margin covers a single-core host where a concurrent XLA compile can
+        # starve this process for tens of seconds (observed in CI-style runs).
+        if not ready.wait(self.config.rpc_connect_timeout_s + 80):
             raise TimeoutError("driver failed to connect to controller")
 
     async def _async_init(self, ready: threading.Event | None = None):

@@ -64,11 +64,23 @@ class EngineConfig:
     # a block of N amortizes it N-fold. Cost: admissions happen between
     # blocks, and a slot finishing mid-block discards its tail tokens.
     decode_block: int = 8
-    # KV page size (tokens). max_seq must be a multiple; prefill buckets are
-    # rounded up to multiples.
+    # KV cache layout:
+    # - "paged": block-paged pool (vLLM's core idea) — memory scales with
+    #   reserved pages, admission is page-budgeted, many more slots than a
+    #   dense cache can be configured. Decode attends through the page table
+    #   with the Pallas paged kernel.
+    # - "dense": contiguous [B, max_seq] per slot — highest single-chip
+    #   decode throughput (XLA fuses the einsum attention with the
+    #   projections); memory is slots x max_seq regardless of actual
+    #   lengths. The host-side scheduler (bucketed grouped prefill,
+    #   per-group TTFT, adaptive decode blocks) is shared by both.
+    kv_layout: str = "dense"
+    # KV page size (tokens), paged layout only. max_seq must be a multiple;
+    # prefill buckets are rounded up to multiples.
     page_size: int = 128
-    # Page-pool size. 0 -> dense parity (max_slots * max_seq / page_size) + 1.
-    # Smaller pools trade concurrency ceilings for memory: admission reserves
+    # Page-pool size, paged layout only. 0 -> dense parity
+    # (max_slots * max_seq / page_size) + 1. Smaller pools trade concurrency
+    # ceilings for memory: admission reserves
     # ceil((prompt + max_tokens + decode_block)/page_size) pages per request
     # and queues when the pool is dry.
     total_pages: int = 0
@@ -112,6 +124,36 @@ def _prefill_layer(x, lp, cfg: TransformerConfig, positions, seg):
     return x, k, v
 
 
+def _decode_layer_dense(x, lp, ck, cv, cfg: TransformerConfig, lengths):
+    """Dense-layout one-token step against a [B, S, KV, Hd] cache slice:
+    pure-XLA einsum attention (fuses with the projections; the fastest path
+    on a single chip where the cache is a contiguous per-slot matrix)."""
+    dt = x.dtype
+    B = x.shape[0]
+    S = ck.shape[1]
+    KV, Hd = ck.shape[2], ck.shape[3]
+    group = cfg.n_heads // cfg.kv_heads
+    h = _rms_norm(x, lp["attn_norm"])
+    q, k_new, v_new = _attn_proj(h, lp, cfg, dt)  # q:[B,1,H,Hd] k/v:[B,1,KV,Hd]
+    pos = lengths[:, None]
+    q = _rope(q, pos, cfg.rope_theta)
+    k_new = _rope(k_new, pos, cfg.rope_theta)
+    rows = jnp.arange(B)
+    ck = ck.at[rows, lengths].set(k_new[:, 0])
+    cv = cv.at[rows, lengths].set(v_new[:, 0])
+    qg = q[:, 0].reshape(B, KV, group, Hd)
+    scores = jnp.einsum("bkgh,bskh->bkgs", qg, ck).astype(jnp.float32)
+    scores = scores / math.sqrt(Hd)
+    valid = (jnp.arange(S)[None, :] <= lengths[:, None])[:, None, None, :]
+    scores = jnp.where(valid, scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1).astype(dt)
+    o = jnp.einsum("bkgs,bskh->bkgh", p, cv).reshape(B, 1, cfg.n_heads, Hd)
+    x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
+    h = _rms_norm(x, lp["ffn_norm"])
+    x = x + _dense_ffn(h, lp)
+    return x, ck, cv
+
+
 def _sample(logits, temperature, key):
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -129,25 +171,45 @@ class LLMEngine:
         if self.ec.max_seq <= 0:
             self.ec = dataclasses.replace(self.ec, max_seq=cfg.max_seq_len)
         S = self.ec.max_seq
-        ps = self.ec.page_size
-        if S % ps:
+        self.paged = self.ec.kv_layout == "paged"
+        if self.ec.kv_layout not in ("paged", "dense"):
+            raise ValueError(f"unknown kv_layout {self.ec.kv_layout!r} (paged|dense)")
+        if not self.paged and (self.ec.total_pages > 0 or self.ec.page_size != 128):
+            # Page knobs only mean something in the paged layout; silently
+            # ignoring an explicit page budget could OOM the chip (dense
+            # allocates slots x max_seq regardless).
+            raise ValueError(
+                "total_pages/page_size were set but kv_layout is 'dense'; "
+                "pass kv_layout='paged' for page-budgeted memory"
+            )
+        ps = self.ec.page_size if self.paged else S
+        if self.paged and S % ps:
             raise ValueError(f"max_seq {S} must be a multiple of page_size {ps}")
-        if self.ec.total_pages <= 0:
+        if self.paged and self.ec.total_pages <= 0:
             self.ec = dataclasses.replace(
                 self.ec, total_pages=self.ec.max_slots * (S // ps) + 1
             )
         self.params = params if params is not None else init_params(jax.random.PRNGKey(self.ec.seed), cfg)
         L = cfg.n_layers
         B = self.ec.max_slots
-        P_total = self.ec.total_pages
-        self.ppseq = S // ps  # page-table width (max pages per sequence)
-        # Linear page pool: position (page, offset) lives at page*ps + offset.
-        pool_shape = (L, cfg.kv_heads, P_total * ps, cfg.head_dim)
-        self.k_pages = jnp.zeros(pool_shape, cfg.dtype)
-        self.v_pages = jnp.zeros(pool_shape, cfg.dtype)
-        self.free_pages: deque = deque(range(1, P_total))  # page 0 = dead sink
-        self.page_tables = np.zeros((B, self.ppseq), np.int32)
-        self.d_page_tables = jnp.zeros((B, self.ppseq), jnp.int32)
+        if self.paged:
+            P_total = self.ec.total_pages
+            self.ppseq = S // ps  # page-table width (max pages per sequence)
+            # Linear page pool: position (page, offset) lives at page*ps + offset.
+            pool_shape = (L, cfg.kv_heads, P_total * ps, cfg.head_dim)
+            self.k_pages = jnp.zeros(pool_shape, cfg.dtype)
+            self.v_pages = jnp.zeros(pool_shape, cfg.dtype)
+            self.free_pages: deque = deque(range(1, P_total))  # page 0 = dead sink
+            self.page_tables = np.zeros((B, self.ppseq), np.int32)
+            self.d_page_tables = jnp.zeros((B, self.ppseq), jnp.int32)
+        else:
+            # Dense per-slot cache (one virtual page of max_seq per slot).
+            self.ppseq = 1
+            self.k_pages = jnp.zeros((L, B, S, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+            self.v_pages = jnp.zeros_like(self.k_pages)
+            self.free_pages = deque()
+            self.page_tables = np.zeros((B, 1), np.int32)
+            self.d_page_tables = jnp.zeros((B, 1), jnp.int32)
         self.lengths = np.zeros(B, np.int32)  # host copy drives scheduling
         # Device-resident mirrors: decode blocks read/advance these without
         # any host->device transfer per step.
@@ -157,10 +219,16 @@ class LLMEngine:
         self.waiting: deque = deque()
         self._key = jax.random.PRNGKey(self.ec.seed + 1)
         self._prefill_jit: dict[int, Any] = {}
-        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2), static_argnums=(6,))
-        # Buckets: page-size multiples only (a prefill writes whole pages).
+        if self.paged:
+            self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2), static_argnums=(6,))
+        else:
+            self._decode_jit = jax.jit(self._decode_impl_dense, donate_argnums=(1, 2), static_argnums=(5,))
+        # Buckets: page-size multiples only (a prefill writes whole pages;
+        # dense ps == max_seq, so buckets pass through untouched).
+        bucket_quantum = self.ec.page_size if self.paged else 1
         self.buckets = tuple(sorted(
-            {min(ps * math.ceil(b / ps), S) for b in self.ec.prefill_buckets if b <= S} | {S}
+            {min(bucket_quantum * math.ceil(b / bucket_quantum), S)
+             for b in self.ec.prefill_buckets if b <= S} | {S}
         ))
         # Prefill group sizes, largest-first (greedy grouping caps the
         # number of compiled (bucket, k) programs at |buckets| x |k_buckets|).
@@ -171,6 +239,8 @@ class LLMEngine:
 
     # -- page accounting ---------------------------------------------------
     def _pages_needed(self, prompt_len: int, max_tokens: int) -> int:
+        if not self.paged:
+            return 0  # dense: admission is bounded by slots, not pages
         # + decode_block: a block may overshoot a slot's budget before the
         # host absorbs it; the slack pages keep those writes inside the
         # request's own reservation.
@@ -268,24 +338,75 @@ class LLMEngine:
         )
         return k_pages, v_pages, toks, last, lengths
 
-    def _prefill_batch_impl(self, params, k_pages, v_pages, tokens, lengths, page_idxs, key):
+    def _prefill_batch_impl(self, params, k_pages, v_pages, tokens, lengths, third, key):
         """Prefill k requests of one length bucket in ONE device program
         (scan over requests around the single-request body): one dispatch per
         admitted group instead of one per request — on a remote/tunneled chip
         the per-call latency dominates prefill compute, so this is the main
-        TTFT lever under load. tokens: [k, P]; page_idxs: [k, P // ps]."""
+        TTFT lever under load. tokens: [k, P]; `third` is the per-request
+        placement input: page rows [k, P // ps] (paged) or slot ids [k]
+        (dense); the layout-specific impl is picked once here."""
         keys = jax.random.split(key, tokens.shape[0])
+        impl = self._prefill_impl if self.paged else self._prefill_impl_dense
 
         def scan_req(carry, xs):
             kp, vp = carry
-            toks_i, len_i, pg_i, key_i = xs
-            kp, vp, tok = self._prefill_impl(params, kp, vp, toks_i, len_i, pg_i, key_i)
+            toks_i, len_i, third_i, key_i = xs
+            kp, vp, tok = impl(params, kp, vp, toks_i, len_i, third_i, key_i)
             return (kp, vp), tok
 
         (k_pages, v_pages), toks = jax.lax.scan(
-            scan_req, (k_pages, v_pages), (tokens, lengths, page_idxs, keys)
+            scan_req, (k_pages, v_pages), (tokens, lengths, third, keys)
         )
         return k_pages, v_pages, toks  # toks: [k]
+
+    def _prefill_impl_dense(self, params, cache_k, cache_v, tokens, length, slot, key):
+        """Dense layout: K/V land in one dynamic_update_slice at the slot row."""
+        cfg = self.cfg
+        P = tokens.shape[0]
+        x = params["embed"].astype(cfg.dtype)[tokens][None]  # [1,P,D]
+        pos = jnp.arange(P, dtype=jnp.int32)[None]
+        seg = (pos >= length).astype(jnp.int32)  # pads = their own segment
+
+        def scan_fn(h, xs):
+            lp, ck_l, cv_l = xs
+            h, k_new, v_new = _prefill_layer(h, lp, cfg, pos, seg)
+            ck_l = jax.lax.dynamic_update_slice(ck_l, k_new.astype(ck_l.dtype), (slot, 0, 0, 0))
+            cv_l = jax.lax.dynamic_update_slice(cv_l, v_new.astype(cv_l.dtype), (slot, 0, 0, 0))
+            return h, (ck_l, cv_l)
+
+        x, (cache_k, cache_v) = jax.lax.scan(scan_fn, x, (params["layers"], cache_k, cache_v))
+        x = _rms_norm(x, params["final_norm"])
+        last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
+        logits = last @ params["lm_head"].astype(cfg.dtype)
+        tok = _sample(logits.astype(jnp.float32), self.ec.temperature, key)
+        return cache_k, cache_v, tok
+
+    def _decode_impl_dense(self, params, cache_k, cache_v, last_tokens, lengths, n_steps, key):
+        """Dense layout: n_steps for every slot in one program; attention is
+        the fused einsum over each slot's contiguous [S] row."""
+        cfg = self.cfg
+
+        def one_step(carry, step_key):
+            ck, cv, last, lens = carry
+            x = params["embed"].astype(cfg.dtype)[last][:, None, :]  # [B,1,D]
+
+            def scan_fn(h, xs):
+                lp, ck_l, cv_l = xs
+                h, ck_l, cv_l = _decode_layer_dense(h, lp, ck_l, cv_l, cfg, lens)
+                return h, (ck_l, cv_l)
+
+            x, (ck, cv) = jax.lax.scan(scan_fn, x, (params["layers"], ck, cv))
+            x = _rms_norm(x, params["final_norm"])
+            logits = jnp.einsum("bsd,dv->bv", x, params["lm_head"].astype(cfg.dtype))
+            toks = _sample(logits.astype(jnp.float32), self.ec.temperature, step_key)
+            return (ck, cv, toks, lens + 1), toks
+
+        keys = jax.random.split(key, n_steps)
+        (cache_k, cache_v, last, lengths), toks = jax.lax.scan(
+            one_step, (cache_k, cache_v, last_tokens, lengths), keys
+        )
+        return cache_k, cache_v, toks, last, lengths
 
     def _prefill(self, bucket: int, k: int):
         fn = self._prefill_jit.get((bucket, k))
@@ -318,9 +439,12 @@ class LLMEngine:
             for k in k_values:
                 toks = jnp.zeros((k, b), jnp.int32)
                 lens = jnp.ones(k, jnp.int32)
-                pgs = jnp.zeros((k, b // ps), jnp.int32)  # all writes -> dead page
+                if self.paged:
+                    third = jnp.zeros((k, b // ps), jnp.int32)  # writes -> dead page
+                else:
+                    third = jnp.zeros(k, jnp.int32)  # slot 0 (reset below)
                 self.k_pages, self.v_pages, td = self._prefill(b, k)(
-                    self.params, self.k_pages, self.v_pages, toks, lens, pgs, key
+                    self.params, self.k_pages, self.v_pages, toks, lens, third, key
                 )
                 # The admit path's per-group mirror updates are their own tiny
                 # jitted programs, one shape variant per k — compile them here
@@ -330,10 +454,16 @@ class LLMEngine:
                 self.d_last = self.d_last.at[idxs].set(td)
                 jax.device_get(td)
         for n in self.block_sizes:
-            out = self._decode_jit(
-                self.params, self.k_pages, self.v_pages, self.d_last, self.d_lengths,
-                self.d_page_tables, n, key,
-            )
+            if self.paged:
+                out = self._decode_jit(
+                    self.params, self.k_pages, self.v_pages, self.d_last,
+                    self.d_lengths, self.d_page_tables, n, key,
+                )
+            else:
+                out = self._decode_jit(
+                    self.params, self.k_pages, self.v_pages, self.d_last,
+                    self.d_lengths, n, key,
+                )
             self.k_pages, self.v_pages = out[0], out[1]
             jax.device_get(out[2])
         # Reset device mirrors dirtied by the dummy executions.
@@ -345,7 +475,7 @@ class LLMEngine:
         if len(tokens) >= self.ec.max_seq:
             raise ValueError(f"prompt length {len(tokens)} >= max_seq {self.ec.max_seq}")
         need = self._pages_needed(len(tokens), max_tokens)
-        if need > self.ec.total_pages - 1:
+        if self.paged and need > self.ec.total_pages - 1:
             raise ValueError(
                 f"request needs {need} pages > pool size {self.ec.total_pages - 1}"
             )
@@ -414,25 +544,27 @@ class LLMEngine:
             by_bucket.setdefault(item[3], []).append(item)
         dispatched: list[tuple[list, Any]] = []  # (chunk, toks_dev)
         for bucket, group in by_bucket.items():
-            n_pg = bucket // ps
+            n_pg = bucket // ps if self.paged else 1
             while group:
                 k = next(kb for kb in self.k_buckets if kb <= len(group))
                 chunk, group = group[:k], group[k:]
                 idxs = [it[0] for it in chunk]
                 padded = np.zeros((k, bucket), np.int32)
                 lens = np.zeros(k, np.int32)
-                pgs = np.zeros((k, n_pg), np.int32)
+                pgs = np.zeros((k, n_pg), np.int32) if self.paged else None
                 for j, (i, _rid, tokens, _b, _mt, _arr) in enumerate(chunk):
                     padded[j, : len(tokens)] = tokens
                     lens[j] = len(tokens)
-                    own = self.page_tables[i, : n_pg]
-                    pgs[j] = own  # trailing zeros -> dead page sink
+                    if self.paged:
+                        pgs[j] = self.page_tables[i, :n_pg]  # trailing zeros -> dead sink
+                idx_arr = jnp.asarray(np.asarray(idxs, np.int32))
+                # Paged: per-request page rows; dense: the slot index.
+                third = jnp.asarray(pgs) if self.paged else idx_arr
                 self._key, sub = jax.random.split(self._key)
                 self.k_pages, self.v_pages, toks_dev = self._prefill(bucket, k)(
                     self.params, self.k_pages, self.v_pages,
-                    jnp.asarray(padded), jnp.asarray(lens), jnp.asarray(pgs), sub,
+                    jnp.asarray(padded), jnp.asarray(lens), third, sub,
                 )
-                idx_arr = jnp.asarray(np.asarray(idxs, np.int32))
                 self.d_lengths = self.d_lengths.at[idx_arr].set(jnp.asarray(lens))
                 self.d_last = self.d_last.at[idx_arr].set(toks_dev)
                 dispatched.append((chunk, toks_dev))
@@ -470,10 +602,16 @@ class LLMEngine:
                 if n not in self.block_sizes:  # cap hit: snap to a compiled size
                     n = self.block_sizes[0]
                 self._key, sub = jax.random.split(self._key)
-                (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
-                    self.params, self.k_pages, self.v_pages, self.d_last,
-                    self.d_lengths, self.d_page_tables, n, sub,
-                )
+                if self.paged:
+                    (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
+                        self.params, self.k_pages, self.v_pages, self.d_last,
+                        self.d_lengths, self.d_page_tables, n, sub,
+                    )
+                else:
+                    (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
+                        self.params, self.k_pages, self.v_pages, self.d_last,
+                        self.d_lengths, n, sub,
+                    )
                 for i in active:
                     self.slots[i].n_generated += n
         if toks is not None:

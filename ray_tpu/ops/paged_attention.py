@@ -60,47 +60,53 @@ def paged_attention_reference(q, k_pages, v_pages, lengths, page_indices, scale=
 # ---------------------------------------------------------------------------
 
 def _paged_kernel(lens_ref, pidx_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale, ps, n_pages):
+                  m_scr, l_scr, acc_scr, *, scale, ps, n_pages, kv):
+    """Grid (B, n_pages): ONE page DMA carries ALL kv heads (page ids are
+    shared across heads in the pool layout), and the head loop unrolls
+    statically inside the step — 4-8x fewer, larger DMAs than a per-head
+    grid, which is what the decode path's throughput is bound by."""
     from jax.experimental import pallas as pl
 
     b = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
-        m_scr[:, :] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:, :] = jnp.zeros_like(l_scr)
-        acc_scr[:, :] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
     length = lens_ref[b]
     start = j * ps
 
     @pl.when(start < length)
     def _compute():
-        q = q_ref[0, 0]  # [Gp, D]
-        k = k_ref[0, 0]  # [ps, D]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [Gp, ps]
-        cols = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(cols < length, s, NEG_INF)
-        m_prev = m_scr[:, 0]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur[:, None])
-        l_cur = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
-        acc_scr[:, :] = acc_scr[:, :] * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_scr[:, :] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
-        l_scr[:, :] = jnp.broadcast_to(l_cur[:, None], l_scr.shape)
+        for h in range(kv):  # static unroll: kv is small (2-8)
+            q = q_ref[0, h]  # [Gp, D]
+            k = k_ref[h, 0]  # [ps, D]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # [Gp, ps]
+            cols = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols < length, s, NEG_INF)
+            m_prev = m_scr[h, :, 0]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            alpha = jnp.exp(m_prev - m_cur)
+            p = jnp.exp(s - m_cur[:, None])
+            l_cur = l_scr[h, :, 0] * alpha + jnp.sum(p, axis=1)
+            acc_scr[h] = acc_scr[h] * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[h, 0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_scr[h] = jnp.broadcast_to(m_cur[:, None], m_scr.shape[1:])
+            l_scr[h] = jnp.broadcast_to(l_cur[:, None], l_scr.shape[1:])
 
     @pl.when(j == n_pages - 1)
     def _finalize():
-        l = l_scr[:, 0]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[:, :] / l_safe[:, None]).astype(o_ref.dtype)
+        for h in range(kv):
+            l = l_scr[h, :, 0]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, h] = (acc_scr[h] / l_safe[:, None]).astype(o_ref.dtype)
 
 
 def _paged_pallas(q, k_pages, v_pages, lengths, page_indices, *, scale, interpret):
@@ -114,26 +120,28 @@ def _paged_pallas(q, k_pages, v_pages, lengths, page_indices, *, scale, interpre
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, KV, n_pages),
+        grid=(B, n_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, Gp, D), lambda b, h, j, lens, pidx: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, D), lambda b, h, j, lens, pidx: (h, pidx[b, j], 0, 0)),
-            pl.BlockSpec((1, 1, ps, D), lambda b, h, j, lens, pidx: (h, pidx[b, j], 0, 0)),
+            pl.BlockSpec((1, KV, Gp, D), lambda b, j, lens, pidx: (b, 0, 0, 0)),
+            pl.BlockSpec((KV, 1, ps, D), lambda b, j, lens, pidx: (0, pidx[b, j], 0, 0)),
+            pl.BlockSpec((KV, 1, ps, D), lambda b, j, lens, pidx: (0, pidx[b, j], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, Gp, D), lambda b, h, j, lens, pidx: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, KV, Gp, D), lambda b, j, lens, pidx: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((Gp, 128), jnp.float32),
-            pltpu.VMEM((Gp, 128), jnp.float32),
-            pltpu.VMEM((Gp, D), jnp.float32),
+            pltpu.VMEM((KV, Gp, 128), jnp.float32),
+            pltpu.VMEM((KV, Gp, 128), jnp.float32),
+            pltpu.VMEM((KV, Gp, D), jnp.float32),
         ],
     )
-    kernel = functools.partial(_paged_kernel, scale=scale, ps=ps, n_pages=n_pages)
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, ps=ps, n_pages=n_pages, kv=KV
+    )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, Gp, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(lengths, page_indices, q, k_pages, v_pages)
