@@ -147,6 +147,43 @@ def cmd_dashboard(args):
         pass
 
 
+def cmd_drain(args):
+    """Operator drain/undrain (reference: `ray drain-node`): a draining node
+    accepts no new work but keeps serving what it runs."""
+    rt = _connect(args.address)
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    method = "undrain_node" if args.undo else "drain_node"
+    reply = core._run(core.controller.call(method, {"node_id": args.node_id}))
+    if not reply.get("ok"):
+        raise SystemExit(f"{method} failed: {reply}")
+    if args.undo:
+        print(f"node {args.node_id[:12]} reopened for scheduling")
+    else:
+        print(
+            f"node {args.node_id[:12]} draining "
+            f"({'idle — safe to terminate' if reply.get('idle') else 'still running work'})"
+        )
+
+
+def cmd_profile(args):
+    """On-demand CPU profile of a running worker (py-spy-equivalent)."""
+    rt = _connect(args.address)
+    from ray_tpu.core.api import profile_worker
+
+    prof = profile_worker(args.worker_addr, args.duration)
+    top = sorted(prof["stacks"].items(), key=lambda kv: -kv[1])[: args.top]
+    print(f"{prof['samples']} samples over {prof['duration_s']}s:")
+    depth = max(0, args.depth)
+    for stack, count in top:
+        frames = stack.split(";")
+        print(f"  {count:6d}  {frames[-1]}")
+        context = frames[:-1][-depth:] if depth else []
+        for f in reversed(context):
+            print(f"          ^ {f}")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray_tpu")
     p.add_argument("--address", default=None, help="controller address host:port")
@@ -169,6 +206,14 @@ def main(argv=None):
     tp.add_argument("--out", default="timeline.json")
     dp = sub.add_parser("dashboard")
     dp.add_argument("--port", type=int, default=8265)
+    dr = sub.add_parser("drain")
+    dr.add_argument("node_id")
+    dr.add_argument("--undo", action="store_true", help="reopen the node")
+    pr = sub.add_parser("profile")
+    pr.add_argument("worker_addr", help="worker IP:PORT (see `list actors`)")
+    pr.add_argument("--duration", type=float, default=2.0)
+    pr.add_argument("--top", type=int, default=10)
+    pr.add_argument("--depth", type=int, default=4)
     args = p.parse_args(argv)
     {
         "status": cmd_status,
@@ -178,6 +223,8 @@ def main(argv=None):
         "job": cmd_job,
         "timeline": cmd_timeline,
         "dashboard": cmd_dashboard,
+        "drain": cmd_drain,
+        "profile": cmd_profile,
     }[args.cmd](args)
 
 

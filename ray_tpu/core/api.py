@@ -354,6 +354,21 @@ def list_named_actors(namespace: str | None = None) -> list[dict]:
     return core._run(core.controller.call("list_named_actors", {"namespace": namespace}))
 
 
+def profile_worker(worker_addr: str, duration_s: float = 2.0) -> dict:
+    """On-demand CPU profile of a running worker (stack sampling; reference:
+    the dashboard reporter's py-spy endpoint). Shared by the dashboard's
+    /api/profile and the `ray_tpu profile` CLI."""
+    core = _require_worker()
+
+    async def go():
+        conn = await core._peer_conn(worker_addr)
+        return await conn.call(
+            "profile_cpu", {"duration_s": duration_s}, timeout=duration_s + 30
+        )
+
+    return core._run(go())
+
+
 def cluster_resources() -> dict:
     state = _cluster_state()
     total: dict = {}

@@ -63,8 +63,7 @@ def _payload(path: str):
     core = api._require_worker()
     if path.startswith("/api/profile"):
         # On-demand CPU profile of a running worker (reference: dashboard
-        # reporter's py-spy endpoint, profile_manager.py:60-100): dial the
-        # worker and sample its threads.
+        # reporter's py-spy endpoint, profile_manager.py:60-100).
         from urllib.parse import parse_qs, urlsplit
 
         q = parse_qs(urlsplit(path).query)
@@ -72,14 +71,7 @@ def _payload(path: str):
         if not addr:
             return {"error": "pass ?addr=IP:PORT (see /api/cluster actors)"}
         duration = float((q.get("duration") or ["2.0"])[0])
-
-        async def profile():
-            conn = await core._peer_conn(addr)
-            return await conn.call(
-                "profile_cpu", {"duration_s": duration}, timeout=duration + 30
-            )
-
-        return core._run(profile())
+        return api.profile_worker(addr, duration)
     if path == "/api/cluster":
         return core._run(core.controller.call("get_cluster_state", {}))
     if path == "/api/events":

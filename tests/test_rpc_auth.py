@@ -47,3 +47,40 @@ def test_token_cluster_end_to_end_and_rejects_raw_peers():
         shutdown()
         cluster.shutdown()
         rpc.set_auth_token(None)  # don't leak the token into later sessions
+
+
+def test_auto_session_token(tmp_path):
+    """Clusters mint a session RPC token by default; same-host drivers pick
+    it up from the session token file; raw unauthenticated peers are dropped
+    (reference: rpc/authentication — auth required by default)."""
+    import pickle
+    import socket
+
+    import ray_tpu as rt
+    from ray_tpu.core import rpc
+    from ray_tpu.core.api import Cluster, init, shutdown
+
+    cluster = Cluster(initialize_head=False)  # no explicit token
+    cluster.add_node(num_cpus=2)
+    assert cluster.config.auth_token, "auto token not minted"
+    init(address=cluster.address)
+    try:
+        assert rpc.get_auth_token(), "driver did not adopt the session token"
+
+        @rt.remote
+        def f(x):
+            return x * 2
+
+        assert rt.get(f.remote(21), timeout=60) == 42
+        # Raw peer without the token: dropped before unpickling.
+        host, port = cluster.address.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=10)
+        frame = pickle.dumps((0, 1, "get_cluster_state", {}), protocol=5)
+        s.sendall(len(frame).to_bytes(8, "little") + frame)
+        s.settimeout(5)
+        assert s.recv(1024) == b""
+        s.close()
+    finally:
+        shutdown()
+        cluster.shutdown()
+        rpc.set_auth_token(None)

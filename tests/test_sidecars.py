@@ -186,38 +186,43 @@ def test_dashboard_profile_and_ui(shared_ray):
         rt.kill(a)
 
 
-def test_auto_session_token(tmp_path):
-    """Clusters mint a session RPC token by default; same-host drivers pick
-    it up from the session token file; raw unauthenticated peers are dropped
-    (reference: rpc/authentication — auth required by default)."""
-    import pickle
-    import socket
-
+def test_cli_drain_and_profile(shared_ray, capsys):
+    """`python -m ray_tpu drain/profile` operator commands."""
     import ray_tpu as rt
-    from ray_tpu.core import rpc
-    from ray_tpu.core.api import Cluster, init, shutdown
+    from ray_tpu.__main__ import main as cli
+    from ray_tpu.core import api as _api
 
-    cluster = Cluster(initialize_head=False)  # no explicit token
-    cluster.add_node(num_cpus=2)
-    assert cluster.config.auth_token, "auto token not minted"
-    init(address=cluster.address)
+    @rt.remote
+    class Idler:
+        def spin(self, n):
+            import time as _t
+
+            t0 = _t.time()
+            while _t.time() - t0 < n:
+                sum(range(1000))
+            return True
+
+    a = Idler.remote()
+    rt.get(a.spin.remote(0.01), timeout=60)
+    core = _api._require_worker()
+    state = core._run(core.controller.call("get_cluster_state", {}))
+    node_id = next(iter(state["nodes"]))
+    addr = state["actors"][a._actor_id.hex()]["worker_addr"]
+
+    caddr = core.controller_addr
     try:
-        assert rpc.get_auth_token(), "driver did not adopt the session token"
-
-        @rt.remote
-        def f(x):
-            return x * 2
-
-        assert rt.get(f.remote(21), timeout=60) == 42
-        # Raw peer without the token: dropped before unpickling.
-        host, port = cluster.address.rsplit(":", 1)
-        s = socket.create_connection((host, int(port)), timeout=10)
-        frame = pickle.dumps((0, 1, "get_cluster_state", {}), protocol=5)
-        s.sendall(len(frame).to_bytes(8, "little") + frame)
-        s.settimeout(5)
-        assert s.recv(1024) == b""
-        s.close()
+        cli(["--address", caddr, "drain", node_id])
+        assert "draining" in capsys.readouterr().out
+        assert core._run(core.controller.call("get_cluster_state", {}))["nodes"][node_id]["draining"]
     finally:
-        shutdown()
-        cluster.shutdown()
-        rpc.set_auth_token(None)
+        # The shared cluster's only node must never stay drained (every later
+        # test in this module would pend forever).
+        cli(["--address", caddr, "drain", node_id, "--undo"])
+    assert "reopened" in capsys.readouterr().out
+
+    ref = a.spin.remote(3.0)
+    cli(["--address", caddr, "profile", addr, "--duration", "1.0"])
+    out = capsys.readouterr().out
+    assert "samples over" in out and "spin" in out
+    rt.get(ref, timeout=60)
+    rt.kill(a)
