@@ -191,6 +191,10 @@ def serve_phase():
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    for i in range(n_requests):
+        assert res[i][0] is not None, (
+            f"request {i} produced no 'data:' chunk (ttfb is None); raw result: {res[i]!r}"
+        )
     ttfts = sorted(res[i][0] for i in range(n_requests))
     decoded = sum(res[i][1] for i in range(n_requests))
     out = {

@@ -7,7 +7,11 @@ services, e.g. CoreWorkerService.PushTask flowing caller->callee and
 PubsubLongPolling flowing callee->caller). Frames are pickled tuples —
 small control messages only; bulk data rides the shared-memory object store.
 
-Wire format: 8-byte little-endian length, then [16-byte session tag when a
+Wire format: 8-byte little-endian length, then 1 version byte
+(WIRE_VERSION — the pickle-frame schema generation; a frame from a build
+speaking a different generation is REFUSED with a clear log line before any
+byte of it reaches pickle, so two mixed-version hosts fail loud instead of
+corrupting each other mid-rolling-upgrade), then [16-byte session tag when a
 token is set] + pickle of (kind, msg_id, method_or_status, payload).
 kind: 0=request, 1=reply, 2=notify (no reply expected).
 
@@ -42,6 +46,13 @@ logger = logging.getLogger(__name__)
 _REQ, _REP, _NOTIFY = 0, 1, 2
 _HDR = 8
 _TAG_LEN = 16
+# Wire-format generation. Bump when the frame schema changes (pickle tuple
+# shape, tag algorithm/length, header layout). Reference: protobuf gives the
+# reference schema evolution for free; pickle frames get a refuse-on-mismatch
+# version byte instead. Chosen != 0x80 (pickle PROTO opcode) so pre-version
+# builds are also rejected, not misparsed.
+WIRE_VERSION = 1
+_VER = bytes([WIRE_VERSION])
 # Sanity cap on a declared frame length: readexactly buffers the whole frame
 # BEFORE the auth check can reject the peer, so an untrusted header must not
 # be able to demand unbounded memory.
@@ -125,8 +136,7 @@ class Connection:
 
     async def _send(self, frame: tuple):
         data = pickle.dumps(frame, protocol=5)
-        if _frame_key:
-            data = _tag(data) + data
+        data = _VER + _tag(data) + data if _frame_key else _VER + data
         async with self._send_lock:
             self.writer.write(len(data).to_bytes(_HDR, "little") + data)
             await self.writer.drain()
@@ -146,8 +156,7 @@ class Connection:
         self._pending[msg_id] = fut
         fut.add_done_callback(lambda f: self._pending.pop(msg_id, None))
         data = pickle.dumps((_REQ, msg_id, method, payload), protocol=5)
-        if _frame_key:
-            data = _tag(data) + data
+        data = _VER + _tag(data) + data if _frame_key else _VER + data
         self.writer.write(len(data).to_bytes(_HDR, "little") + data)
         return fut
 
@@ -182,11 +191,21 @@ class Connection:
                     logger.warning("dropping peer %s: absurd frame length %d", self.peer_name, ln)
                     return
                 data = await self.reader.readexactly(ln)
+                # Version check BEFORE auth/unpickle: a frame from a build
+                # with a different wire generation must never reach pickle.
+                if ln < 1 or data[0] != WIRE_VERSION:
+                    logger.error(
+                        "refusing rpc frame from %s: wire-format version %s, this build speaks %d "
+                        "— all hosts of a session must run the same ray_tpu version; dropping peer",
+                        self.peer_name, data[0] if ln else "<empty>", WIRE_VERSION,
+                    )
+                    return
+                data = memoryview(data)[1:]
                 if _frame_key:
                     # Constant-time per-frame MAC check BEFORE any
                     # unpickling; wrong/missing tag = unauthenticated or
                     # tampered frame, drop the peer.
-                    body = memoryview(data)[_TAG_LEN:]
+                    body = data[_TAG_LEN:]
                     if len(data) < _TAG_LEN or not hmac.compare_digest(data[:_TAG_LEN], _tag(body)):
                         logger.warning("rejecting unauthenticated rpc frame from %s", self.peer_name)
                         return

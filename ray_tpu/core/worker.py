@@ -315,6 +315,7 @@ class CoreWorker:
         # Executor side: streams whose consumer closed early; the producer
         # stops at its next yield.
         self._cancelled_streams: set[bytes] = set()
+        self._live_streams: set[bytes] = set()  # streaming tasks currently executing
         # Transient shm objects (dag zero-copy edges) whose delete was
         # deferred because a consumer view still pins them; reaped later.
         self._shm_garbage: list[ObjectID] = []
@@ -1289,20 +1290,27 @@ class CoreWorker:
         """Execute a pushed task (reference: CoreWorkerService.PushTask ->
         TaskReceiver -> scheduling queue -> execute callback)."""
         spec = self._decode_pushed(conn, p)
-        fn = await self._load_callable(spec.fn_id)
-        loop = asyncio.get_running_loop()
-        self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
+        streaming = spec.num_returns == -1
+        if streaming:
+            self._stream_register(spec.task_id.binary())
         try:
-            if spec.num_returns == -1:
-                n = await self._execute_streaming_task(conn, fn, spec, loop)
-                return {"status": "ok", "streaming_done": n}
-            result = await loop.run_in_executor(self._executor, self._execute_task, fn, spec)
-            returns = await self._package_returns(spec, result)
-            return {"status": "ok", "returns": returns}
-        except BaseException as e:  # noqa: BLE001 - errors propagate to caller
-            return {"status": "error", "error": serialization.RemoteError.from_exception(e, where=f"task {spec.fn_id[:24]}")}
+            fn = await self._load_callable(spec.fn_id)
+            loop = asyncio.get_running_loop()
+            self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
+            try:
+                if streaming:
+                    n = await self._execute_streaming_task(conn, fn, spec, loop)
+                    return {"status": "ok", "streaming_done": n}
+                result = await loop.run_in_executor(self._executor, self._execute_task, fn, spec)
+                returns = await self._package_returns(spec, result)
+                return {"status": "ok", "returns": returns}
+            except BaseException as e:  # noqa: BLE001 - errors propagate to caller
+                return {"status": "error", "error": serialization.RemoteError.from_exception(e, where=f"task {spec.fn_id[:24]}")}
+            finally:
+                self._event("task_exec_end", task_id=spec.task_id.hex())
         finally:
-            self._event("task_exec_end", task_id=spec.task_id.hex())
+            if streaming:
+                self._stream_cleanup(spec.task_id.binary())
 
     async def _execute_streaming_task(self, conn, fn, spec: TaskSpec, loop) -> int:
         """Run a generator task, shipping each yielded item to the caller as
@@ -1333,11 +1341,8 @@ class CoreWorker:
                 count += 1
             return count
 
-        try:
-            return await loop.run_in_executor(self._executor, run)
-        finally:
-            self._gen_ack_state.pop(spec.task_id.binary(), None)
-            self._cancelled_streams.discard(spec.task_id.binary())
+        # Stream state registered/cleaned by handle_push_task's try/finally.
+        return await loop.run_in_executor(self._executor, run)
 
     async def _ship_generator_item(self, conn, spec: TaskSpec, index: int, value):
         tid = spec.task_id.binary()
@@ -1364,6 +1369,19 @@ class CoreWorker:
             },
         )
 
+    def _stream_register(self, tid: bytes):
+        """Mark a streaming task live. MUST run synchronously in the push
+        handler, before its first await: frames are dispatched in wire order,
+        so registering before the handler first yields guarantees a racing
+        generator_close (sent after the submit) observes the stream as live."""
+        self._live_streams.add(tid)
+
+    def _stream_cleanup(self, tid: bytes):
+        """Single place per-stream executor state dies (idempotent)."""
+        self._live_streams.discard(tid)
+        self._gen_ack_state.pop(tid, None)
+        self._cancelled_streams.discard(tid)
+
     def handle_generator_ack(self, conn, p):
         """Executor side: consumer progress for a backpressured stream."""
         st = self._gen_ack_state.get(p["task_id"])
@@ -1373,8 +1391,13 @@ class CoreWorker:
 
     def handle_generator_close(self, conn, p):
         """Executor side: the consumer abandoned this stream. Mark it and
-        wake any backpressure-blocked producer so it observes the close."""
+        wake any backpressure-blocked producer so it observes the close.
+        Only streams still executing are marked — a close that races the
+        stream's own completion (its finally already discarded the entry)
+        must not re-add the id, or long-lived workers leak set entries."""
         tid = p["task_id"]
+        if tid not in self._live_streams:
+            return
         self._cancelled_streams.add(tid)
         st = self._gen_ack_state.get(tid)
         if st is not None:
@@ -1781,7 +1804,16 @@ class CoreWorker:
                 num_returns=num_returns, options=options, caller_addr=caller_addr,
                 actor_id=actor_id, method_name=method, concurrency_group=cg,
             )
-        return await self._actor_runtime.execute(spec, conn)
+        streaming = spec.num_returns == -1
+        if streaming:
+            # Synchronous registration before the first await — see
+            # _stream_register for the ordering contract with generator_close.
+            self._stream_register(spec.task_id.binary())
+        try:
+            return await self._actor_runtime.execute(spec, conn)
+        finally:
+            if streaming:
+                self._stream_cleanup(spec.task_id.binary())
 
 
     # -- compiled DAG stages (ray_tpu.dag; channels ride the existing peer
@@ -1960,8 +1992,6 @@ class ActorRuntime:
                         count += 1
                 finally:
                     await agen.aclose()
-                    self.core._gen_ack_state.pop(spec.task_id.binary(), None)
-                    self.core._cancelled_streams.discard(spec.task_id.binary())
             return count
 
         def run():
@@ -1983,11 +2013,9 @@ class ActorRuntime:
                 n += 1
             return n
 
-        try:
-            return await loop.run_in_executor(pool, run)
-        finally:
-            self.core._gen_ack_state.pop(spec.task_id.binary(), None)
-            self.core._cancelled_streams.discard(spec.task_id.binary())
+        # Stream state registered/cleaned by handle_push_actor_task's
+        # try/finally around execute().
+        return await loop.run_in_executor(pool, run)
 
     def _resolve(self, blob):
         args, kwargs = serialization.deserialize(blob)

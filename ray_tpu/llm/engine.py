@@ -598,22 +598,40 @@ class LLMEngine:
             cap = self.ec.max_seq - 1 - int(max(self.lengths[i] for i in active))
             if positive and cap > 0:
                 block = self.block_sizes[0] if self.waiting else self.block_sizes[-1]
-                n = int(max(1, min(block, cap)))
-                if n not in self.block_sizes:  # cap hit: snap to a compiled size
-                    n = self.block_sizes[0]
-                self._key, sub = jax.random.split(self._key)
-                if self.paged:
-                    (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
-                        self.params, self.k_pages, self.v_pages, self.d_last,
-                        self.d_lengths, self.d_page_tables, n, sub,
-                    )
+                # Snap DOWN to a compiled size: an oversized block advances
+                # lengths past max_seq-1 and the clamped device writes would
+                # scribble over the longest slot's earlier KV.
+                fits = [b for b in self.block_sizes if b <= min(block, cap)]
+                if fits:
+                    n = fits[-1]
+                    self._key, sub = jax.random.split(self._key)
+                    if self.paged:
+                        (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
+                            self.params, self.k_pages, self.v_pages, self.d_last,
+                            self.d_lengths, self.d_page_tables, n, sub,
+                        )
+                    else:
+                        (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
+                            self.params, self.k_pages, self.v_pages, self.d_last,
+                            self.d_lengths, n, sub,
+                        )
+                    for i in active:
+                        self.slots[i].n_generated += n
                 else:
-                    (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
-                        self.params, self.k_pages, self.v_pages, self.d_last,
-                        self.d_lengths, n, sub,
-                    )
-                for i in active:
-                    self.slots[i].n_generated += n
+                    # No compiled block fits the headroom left by the longest
+                    # slot(s): retire them (they are within block_sizes[0]
+                    # tokens of max_seq) so the next step has room to decode.
+                    for i in active:
+                        if int(self.lengths[i]) + self.block_sizes[0] >= self.ec.max_seq:
+                            slot = self.slots[i]
+                            ev = events.setdefault(slot.req_id, {"ttft_s": None})
+                            ev["finished"] = True
+                            ev["tokens"] = list(slot.emitted)
+                            ev["ttft_s"] = ev.get("ttft_s") or (
+                                (slot.first_token_at or slot.arrived_at) - slot.arrived_at
+                            )
+                            self._retire(i)
+                            retired = True
         if toks is not None:
             block_toks = np.asarray(jax.device_get(toks))  # [n, B]
             for step_i in range(n):

@@ -52,6 +52,7 @@ class _ReplicaSet:
     """Shared per-process routing state for one deployment."""
 
     REFRESH_S = 1.0
+    AFFINITY_CAP = 1024  # bound on sticky model->replica pins (LRU evicted)
 
     def __init__(self, app_name: str, deployment_name: str):
         self.app = app_name
@@ -113,6 +114,12 @@ class _ReplicaSet:
                 self.replicas = handles
                 self.version = info["version"]
                 self.max_ongoing = info["max_ongoing_requests"]
+                # Drop affinity pins to replicas that left the membership —
+                # stale names are skipped by _pick_locked but would otherwise
+                # sit in the dict forever.
+                self.model_affinity = {
+                    m: r for m, r in self.model_affinity.items() if r in handles
+                }
                 # Keep counts for surviving replicas; drop departed ones.
                 self.ongoing = {n: self.ongoing.get(n, 0) for n in handles}
                 self.cond.notify_all()
@@ -211,6 +218,8 @@ class _ReplicaSet:
             # re-pin the affinity to the new pick.
             sticky = self.model_affinity.get(model_id)
             if sticky in live:
+                self.model_affinity.pop(model_id)  # LRU: move to newest
+                self.model_affinity[model_id] = sticky
                 return sticky
         if len(live) == 1:
             pick = live[0]
@@ -218,7 +227,10 @@ class _ReplicaSet:
             a, b = random.sample(live, 2)
             pick = a if self.ongoing.get(a, 0) <= self.ongoing.get(b, 0) else b
         if model_id:
+            self.model_affinity.pop(model_id, None)
             self.model_affinity[model_id] = pick
+            while len(self.model_affinity) > self.AFFINITY_CAP:  # LRU bound
+                self.model_affinity.pop(next(iter(self.model_affinity)))
         return pick
 
     def fail_over(self, name: str):

@@ -9,6 +9,64 @@ import pytest
 import ray_tpu as rt
 
 
+TRIPPED = []
+
+
+def _trip():
+    """Sentinel reconstructor: executes iff a frame reaches pickle.loads."""
+    TRIPPED.append(1)
+
+
+class _Boom:
+    def __reduce__(self):
+        return (_trip, ())
+
+
+def test_wire_version_mismatch_refused():
+    """A frame stamped with a different wire-format generation is refused —
+    connection dropped with no reply — and its bytes NEVER reach pickle
+    (reference analogue: protobuf schema versioning; here a version byte
+    guards the pickle frames against mixed-build clusters)."""
+    import asyncio
+
+    from ray_tpu.core import rpc
+
+    async def go():
+        class H:
+            def handle_ping(self, conn, p):
+                return "pong"
+
+        server = rpc.RpcServer(H())
+        await server.start()
+        try:
+            # Same-build peer round-trips fine.
+            conn = await rpc.connect(server.address)
+            assert await conn.call("ping", timeout=10) == "pong"
+            await conn.close()
+
+            # Mismatched version byte: refused before unpickling.
+            reader, writer = await asyncio.open_connection(server.host, server.port)
+            body = pickle.dumps((0, 1, "ping", _Boom()), protocol=5)
+            frame = bytes([rpc.WIRE_VERSION + 1]) + body
+            writer.write(len(frame).to_bytes(8, "little") + frame)
+            await writer.drain()
+            data = await reader.read(1024)
+            assert data == b"", f"mismatched-version peer got a reply: {data!r}"
+            writer.close()
+            # A legacy pre-version frame (starts with the pickle PROTO opcode
+            # 0x80, not a version byte) is refused the same way.
+            reader, writer = await asyncio.open_connection(server.host, server.port)
+            writer.write(len(body).to_bytes(8, "little") + body)
+            await writer.drain()
+            assert await reader.read(1024) == b""
+            writer.close()
+        finally:
+            await server.close()
+
+    asyncio.run(go())
+    assert not TRIPPED, "booby-trapped frame was unpickled despite version mismatch"
+
+
 def test_token_cluster_end_to_end_and_rejects_raw_peers():
     from ray_tpu.core import rpc
     from ray_tpu.core.api import Cluster, init, shutdown
