@@ -15,10 +15,12 @@ import sys
 
 def _connect(address: str | None):
     import ray_tpu as rt
+    from ray_tpu import scripts
 
-    addr = address or os.environ.get("RAYTPU_ADDRESS")
+    addr = address or os.environ.get("RAYTPU_ADDRESS") or scripts.head_address()
     if not addr:
-        print("error: no --address and RAYTPU_ADDRESS unset", file=sys.stderr)
+        print("error: no --address, RAYTPU_ADDRESS unset, and no local head "
+              "(start one: python -m ray_tpu start --head)", file=sys.stderr)
         sys.exit(2)
     rt.init(address=addr)
     return rt
@@ -185,9 +187,13 @@ def cmd_profile(args):
 
 
 def main(argv=None):
+    from ray_tpu import scripts
+
     p = argparse.ArgumentParser(prog="ray_tpu")
     p.add_argument("--address", default=None, help="controller address host:port")
     sub = p.add_subparsers(dest="cmd", required=True)
+    scripts.add_start_parser(sub)
+    scripts.add_stop_parser(sub)
     sub.add_parser("status")
     lp = sub.add_parser("list")
     lp.add_argument("kind", choices=["nodes", "actors", "pgs", "jobs"])
@@ -215,6 +221,10 @@ def main(argv=None):
     pr.add_argument("--top", type=int, default=10)
     pr.add_argument("--depth", type=int, default=4)
     args = p.parse_args(argv)
+    if args.cmd == "start":
+        sys.exit(scripts.cmd_start(args))
+    if args.cmd == "stop":
+        sys.exit(scripts.cmd_stop(args))
     {
         "status": cmd_status,
         "list": cmd_list,

@@ -69,6 +69,30 @@ def _session_token_path(address: str) -> str:
     return os.path.join(tempfile.gettempdir(), f"raytpu_token_{port}")
 
 
+def _write_session_token_file(address: str, token: str) -> str | None:
+    """Publish the session token for same-host drivers; returns the path, or
+    None if it couldn't be written safely (joiners then need
+    RAYTPU_AUTH_TOKEN). O_EXCL|O_NOFOLLOW after unlink: an attacker-planted
+    file or symlink at the predictable path must never receive the secret
+    (O_CREAT|O_TRUNC would happily write into it with ITS mode)."""
+    path = _session_token_path(address)
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    try:
+        fd = os.open(
+            path,
+            os.O_WRONLY | os.O_CREAT | os.O_EXCL | getattr(os, "O_NOFOLLOW", 0),
+            0o600,
+        )
+        with os.fdopen(fd, "w") as f:
+            f.write(token)
+        return path
+    except OSError:
+        return None
+
+
 class Cluster:
     """Multi-node cluster on one machine (reference: cluster_utils.Cluster)."""
 
@@ -95,25 +119,9 @@ class Cluster:
         self.controller_addr = self.host.call(self.controller.start())
         self._token_file = None
         if self.config.auth_token:
-            # O_EXCL|O_NOFOLLOW after unlink: an attacker-planted file or
-            # symlink at the predictable path must never receive the secret
-            # (O_CREAT|O_TRUNC would happily write into it with ITS mode).
-            path = _session_token_path(self.controller_addr)
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            try:
-                fd = os.open(
-                    path,
-                    os.O_WRONLY | os.O_CREAT | os.O_EXCL | getattr(os, "O_NOFOLLOW", 0),
-                    0o600,
-                )
-                with os.fdopen(fd, "w") as f:
-                    f.write(self.config.auth_token)
-                self._token_file = path
-            except OSError:
-                pass  # couldn't publish safely: joiners must use RAYTPU_AUTH_TOKEN
+            self._token_file = _write_session_token_file(
+                self.controller_addr, self.config.auth_token
+            )
         self.daemons: list[NodeDaemon] = []
         if initialize_head:
             self.add_node(**(head_node_args or {}))
@@ -195,12 +203,21 @@ def init(
     object_store_memory: int | None = None,
     config: Config | None = None,
     log_to_driver: bool = True,
+    node_ip: str | None = None,
 ) -> dict:
-    """Start (or connect to) a cluster and create the driver's CoreWorker."""
+    """Start (or connect to) a cluster and create the driver's CoreWorker.
+
+    node_ip: the routable IP THIS process binds/advertises for its reply
+    server. A driver on a different host than the cluster must set it (or
+    RAYTPU_NODE_IP) — with the loopback default, remote workers could not
+    dial results/objects back.
+    """
     global _global_worker, _global_cluster
     if _global_worker is not None:
         return {"address": _global_worker.controller_addr}
     cfg = config or get_config()
+    if node_ip:
+        cfg.node_ip = node_ip
     if not cfg.auth_token and address is not None:
         # Same-host driver joining an auto-tokened cluster: pick the session
         # token up from the head's token file (multi-host joins pass

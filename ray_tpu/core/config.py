@@ -91,6 +91,14 @@ class Config:
     auth_token: str = ""
     # --- tpu ---
     tpu_chips_per_host_default: int = 4
+    # --- networking ---
+    # Bind/advertise IP for every server this process opens (controller,
+    # node daemon, workers). 127.0.0.1 keeps single-host sessions loopback;
+    # a multi-host deployment passes the host's routable IP (CLI
+    # `start --node-ip` / RAYTPU_NODE_IP) so peers on other hosts can dial
+    # object-transfer and worker-to-worker connections (reference:
+    # --node-ip-address, scripts.py).
+    node_ip: str = "127.0.0.1"
 
     def apply_env(self):
         for f in fields(self):

@@ -133,7 +133,7 @@ def _labels_match(labels: dict, selector: dict) -> bool:
 
 
 class Controller:
-    def __init__(self, config: Config, host: str = "127.0.0.1", persist_path: str | None = None):
+    def __init__(self, config: Config, host: str | None = None, persist_path: str | None = None):
         """persist_path enables control-plane fault tolerance: hard state
         (KV, actors, PGs, jobs, named-actor table) snapshots to this file and
         a restarted Controller on the same address restores it, re-adopting
@@ -142,7 +142,7 @@ class Controller:
         snapshot file plays the Redis role — same recovery contract)."""
         self.config = config
         self.persist_path = persist_path
-        self.server = rpc.RpcServer(self, host=host)
+        self.server = rpc.RpcServer(self, host=host or config.node_ip)
         self.nodes: dict[str, NodeRecord] = {}
         self.kv: dict[str, dict[str, bytes]] = {}  # namespace -> {key: value}
         self.actors: dict[ActorID, ActorRecord] = {}

@@ -51,7 +51,7 @@ class NodeDaemon:
         resources: dict | None = None,
         labels: dict | None = None,
         store_capacity: int | None = None,
-        host: str = "127.0.0.1",
+        host: str | None = None,
         session_dir: str | None = None,
         env: dict | None = None,
         autodetect_accelerators: bool = True,
@@ -70,7 +70,7 @@ class NodeDaemon:
         )
         self.store_capacity = store_capacity or self.config.object_store_memory
         self.store: SharedMemoryClient | None = None
-        self.server = rpc.RpcServer(self, host=host)
+        self.server = rpc.RpcServer(self, host=host or self.config.node_ip)
         self.controller: rpc.Connection | None = None
         self.workers: dict[str, WorkerRecord] = {}
         # Idle pool keyed by runtime-env hash ("" = plain): a lease only
@@ -184,7 +184,11 @@ class NodeDaemon:
                 "actors": actors,
             },
         )
+        # Adopt the head's cluster config, but node_ip is NODE identity
+        # (each host binds its own routable IP) — never the head's.
+        own_ip = self.config.node_ip
         self.config = Config.from_dict(reply["config"])
+        self.config.node_ip = own_ip
 
     async def _heartbeat_loop(self):
         while True:
@@ -230,6 +234,7 @@ class NodeDaemon:
         if self.config.auth_token:
             env["RAYTPU_AUTH_TOKEN"] = self.config.auth_token
         env["RAYTPU_DAEMON_ADDR"] = self.address
+        env["RAYTPU_NODE_IP"] = self.server.host  # workers bind/advertise the node's IP
         env["RAYTPU_STORE_PATH"] = self.store_path
         env["RAYTPU_NODE_ID"] = self.node_id
         env.setdefault("PYTHONPATH", "")

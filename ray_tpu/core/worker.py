@@ -264,7 +264,7 @@ class CoreWorker:
         self.job_id = JobID.nil()
         self.loop: asyncio.AbstractEventLoop | None = None
         self._loop_thread: threading.Thread | None = None
-        self.server = rpc.RpcServer(self)
+        self.server = rpc.RpcServer(self, host=self.config.node_ip)
         self.address = ""
         self.controller: rpc.Connection | None = None
         self.daemon: rpc.Connection | None = None
@@ -375,7 +375,9 @@ class CoreWorker:
         if self.mode == "worker":
             reply = await self.daemon.call("register_worker", {"worker_id": self.worker_id, "address": self.address})
             self.node_id = reply["node_id"]
+            own_ip = self.config.node_ip  # node identity, not cluster config
             self.config = Config.from_dict(reply["config"])
+            self.config.node_ip = own_ip
             if self.store is not None:
                 # The store client predates the config push: re-apply
                 # settings that change ITS behavior (a worker without the

@@ -1,0 +1,385 @@
+"""`ray_tpu start` / `ray_tpu stop` — standalone cluster bootstrap.
+
+Role-equivalent to the reference's `ray start` (/root/reference/python/ray/
+scripts/scripts.py:682): turn THIS host into a head node (control plane + one
+node daemon) or join an existing cluster by address, as long-lived OS
+processes — no shared Python state, which is what makes a real multi-host TPU
+pod deployable (each host runs `start`, drivers connect by address).
+
+Process model: `start` (without --block) re-execs itself detached with
+--block; the blocking child runs an asyncio loop hosting the Controller (head
+only) and a NodeDaemon, writes a state file under the cluster state dir, and
+exits cleanly on SIGTERM. `stop` signals every recorded process. The
+reference uses the same two-step shape (CLI → detached raylet/gcs binaries).
+
+Token distribution: the head mints a session token (unless one is pinned via
+--token / RAYTPU_AUTH_TOKEN) and publishes it (a) to same-host drivers via
+the 0600 session-token file keyed by port (api._session_token_path), and
+(b) to the operator on stdout as part of the join command — joining hosts
+pass it via RAYTPU_AUTH_TOKEN or --token. Every RPC frame is MAC'd with it
+(rpc.py), so a wrong/missing token fails loud at the first frame.
+"""
+from __future__ import annotations
+
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+DEFAULT_PORT = 6379
+
+
+def state_dir() -> str:
+    d = os.environ.get("RAYTPU_STATE_DIR") or os.path.join(
+        tempfile.gettempdir(), f"raytpu-cluster-{os.getuid()}"
+    )
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    return d
+
+
+def _state_path(pid: int) -> str:
+    return os.path.join(state_dir(), f"proc-{pid}.json")
+
+
+def _record_state(role: str, address: str, node_id: str = "") -> str:
+    path = _state_path(os.getpid())
+    with open(path, "w") as f:
+        json.dump(
+            {"pid": os.getpid(), "role": role, "address": address,
+             "node_id": node_id, "started_at": time.time()},
+            f,
+        )
+    return path
+
+
+def head_address() -> str | None:
+    """Most recent LIVE head recorded in the state dir (CLI --address
+    default). Same liveness rules as stop: the pid must still be a ray_tpu
+    process (state files can outlive their process across reboots)."""
+    best = None
+    for name in os.listdir(state_dir()):
+        if not name.startswith("proc-"):
+            continue
+        try:
+            with open(os.path.join(state_dir(), name)) as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            continue
+        pid = st.get("pid")
+        if (st.get("role") == "head" and st.get("address") and isinstance(pid, int)
+                and _alive(pid) and _is_ours(pid)):
+            if best is None or st.get("started_at", 0) > best.get("started_at", 0):
+                best = st
+    return best["address"] if best else None
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _is_ours(pid: int) -> bool:
+    """Refuse to signal a recycled pid: the target must still be a ray_tpu
+    process (state files can outlive their process across reboots)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"ray_tpu" in f.read()
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# blocking (child) mode: actually run the services
+# ---------------------------------------------------------------------------
+
+def _run_blocking(args) -> int:
+    import asyncio
+
+    from ray_tpu.core import rpc
+    from ray_tpu.core.api import _write_session_token_file
+    from ray_tpu.core.config import Config
+    from ray_tpu.core.node import NodeDaemon
+
+    cfg = Config().apply_env()
+    if args.node_ip:
+        cfg.node_ip = args.node_ip
+    token = args.token or os.environ.get("RAYTPU_AUTH_TOKEN") or cfg.auth_token
+    is_head = bool(args.head)
+    if is_head and not token and os.environ.get("RAYTPU_AUTO_TOKEN", "1") != "0":
+        import secrets
+
+        token = secrets.token_hex(16)
+    cfg.auth_token = token
+    if token:
+        rpc.set_auth_token(token)
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    labels = json.loads(args.labels) if args.labels else {}
+
+    async def main() -> int:
+        loop = asyncio.get_running_loop()
+        stop_ev = asyncio.Event()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop_ev.set)
+
+        controller = None
+        token_file = None
+        if is_head:
+            from ray_tpu.core.controller import Controller
+
+            controller = Controller(cfg, persist_path=args.persist or None)
+            addr = await controller.start(args.port)
+            if token:
+                # Same-host drivers pick the session token up from the 0600
+                # token file (api.init does the ownership/mode checks).
+                token_file = _write_session_token_file(addr, token)
+        else:
+            addr = args.address
+
+        daemon = NodeDaemon(
+            addr,
+            config=cfg,
+            resources=resources or None,
+            labels=labels or None,
+            store_capacity=args.object_store_memory,
+            autodetect_accelerators=not args.no_tpu_autodetect,
+        )
+        await daemon.start()
+        _record_state("head" if is_head else "node", addr, daemon.node_id)
+        if args.address_file:
+            tmp = args.address_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(addr)
+            os.replace(tmp, args.address_file)  # atomic: readers never see a partial write
+        print(f"ray_tpu {'head' if is_head else 'node'} up: address={addr} "
+              f"node_id={daemon.node_id[:12]}", flush=True)
+
+        await stop_ev.wait()
+        try:
+            await daemon.stop()
+        finally:
+            if controller is not None:
+                await controller.stop()
+            if token_file:
+                try:
+                    os.unlink(token_file)
+                except OSError:
+                    pass
+            try:
+                os.unlink(_state_path(os.getpid()))
+            except OSError:
+                pass
+        return 0
+
+    return asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# detaching (parent) mode
+# ---------------------------------------------------------------------------
+
+def _child_args(args) -> list[str]:
+    """Re-serialize the parsed start options for the --block child. The token
+    deliberately rides env, not argv (argv is world-readable via ps/procfs)."""
+    out = []
+    if args.head:
+        out.append("--head")
+    if args.address:
+        out.append(f"--address={args.address}")
+    out += ["--port", str(args.port)]
+    if args.node_ip:
+        out += ["--node-ip", args.node_ip]
+    if args.num_cpus is not None:
+        out += ["--num-cpus", str(args.num_cpus)]
+    if args.resources:
+        out += ["--resources", args.resources]
+    if args.labels:
+        out += ["--labels", args.labels]
+    if args.object_store_memory:
+        out += ["--object-store-memory", str(args.object_store_memory)]
+    if args.no_tpu_autodetect:
+        out.append("--no-tpu-autodetect")
+    if args.persist:
+        out += ["--persist", args.persist]
+    return out
+
+
+def _spawn_detached(args) -> int:
+    """Re-exec `start ... --block` as a detached session leader, wait for it
+    to come up (address file), print the join/connect instructions."""
+    addr_file = args.address_file or os.path.join(
+        state_dir(), f"address-{os.getpid()}-{time.time_ns()}"
+    )
+    child_argv = [sys.executable, "-m", "ray_tpu", "start", "--block",
+                  "--address-file", addr_file] + _child_args(args)
+    env = dict(os.environ)
+    if args.token:
+        env["RAYTPU_AUTH_TOKEN"] = args.token
+    log_path = os.path.join(state_dir(), "start.log")
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(
+            child_argv,
+            env=env,
+            start_new_session=True,  # survives this CLI + its terminal
+            stdout=log,
+            stderr=log,
+        )
+    deadline = time.time() + args.startup_timeout
+    addr = None
+    while time.time() < deadline:
+        if os.path.exists(addr_file):
+            with open(addr_file) as f:
+                addr = f.read().strip()
+            if addr:
+                break
+        if proc.poll() is not None:
+            print(f"error: start child exited rc={proc.returncode}; log tail:",
+                  file=sys.stderr)
+            _tail(log_path)
+            return 1
+        time.sleep(0.1)
+    if not addr:
+        print(f"error: node did not come up within {args.startup_timeout}s; log tail:",
+              file=sys.stderr)
+        _tail(log_path)
+        proc.terminate()
+        return 1
+    if not args.address_file:
+        try:
+            os.unlink(addr_file)
+        except OSError:
+            pass
+    if args.head:
+        print(f"ray_tpu head started (pid {proc.pid}).")
+        print(f"  cluster address: {addr}")
+        print(f"  connect a driver:  ray_tpu.init(address=\"{addr}\")  "
+              f"# same host: token auto-discovered")
+        token = args.token or os.environ.get("RAYTPU_AUTH_TOKEN")
+        if not token:
+            # auto-minted inside the child — read it back from the session
+            # token file so we can print a complete join command.
+            from ray_tpu.core.api import _session_token_path
+
+            try:
+                with open(_session_token_path(addr)) as f:
+                    token = f.read().strip()
+            except OSError:
+                token = None
+        if token:
+            print("  join another host:")
+            print(f"    RAYTPU_AUTH_TOKEN={token} python -m ray_tpu start --address={addr} "
+                  f"--node-ip=<that host's IP>")
+        print(f"  stop everything on this host:  python -m ray_tpu stop")
+    else:
+        print(f"ray_tpu node started (pid {proc.pid}), joined {addr}.")
+    return 0
+
+
+def _tail(path: str, n: int = 15):
+    try:
+        with open(path) as f:
+            for line in f.readlines()[-n:]:
+                print("  " + line.rstrip(), file=sys.stderr)
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# CLI entry points
+# ---------------------------------------------------------------------------
+
+def add_start_parser(sub) -> None:
+    sp = sub.add_parser("start", help="start a head node or join a cluster")
+    sp.add_argument("--head", action="store_true",
+                    help="start the control plane on this host")
+    sp.add_argument("--address", default=None,
+                    help="join the cluster whose head controller is at host:port")
+    sp.add_argument("--port", type=int, default=DEFAULT_PORT,
+                    help=f"head controller port (default {DEFAULT_PORT}, 0 = random)")
+    sp.add_argument("--node-ip", default=None,
+                    help="routable IP to bind/advertise (default 127.0.0.1; "
+                         "REQUIRED for multi-host)")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--resources", default=None, help='JSON, e.g. \'{"TPU": 4}\'')
+    sp.add_argument("--labels", default=None, help="JSON node labels")
+    sp.add_argument("--object-store-memory", type=int, default=None)
+    sp.add_argument("--token", default=None,
+                    help="pin the session auth token (else RAYTPU_AUTH_TOKEN, "
+                         "else auto-minted on the head)")
+    sp.add_argument("--no-tpu-autodetect", action="store_true",
+                    help="don't advertise this host's TPU chips/slice labels")
+    sp.add_argument("--persist", default=None,
+                    help="head: controller snapshot path (control-plane FT)")
+    sp.add_argument("--block", action="store_true",
+                    help="run in the foreground (default: detach)")
+    sp.add_argument("--address-file", default=None,
+                    help="write the node's address here once up")
+    sp.add_argument("--startup-timeout", type=float, default=60.0)
+
+
+def cmd_start(args) -> int:
+    if args.head and args.address:
+        print("error: pass --head OR --address, not both", file=sys.stderr)
+        return 2
+    if not args.head and not args.address:
+        print("error: pass --head to start a cluster or --address=<head> to join one",
+              file=sys.stderr)
+        return 2
+    if args.block:
+        return _run_blocking(args)
+    return _spawn_detached(args)
+
+
+def cmd_stop(args) -> int:
+    """Stop every ray_tpu process recorded in the state dir (head + nodes)."""
+    d = state_dir()
+    stopped = 0
+    for name in sorted(os.listdir(d)):
+        if not name.startswith("proc-"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path) as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            continue
+        pid = st["pid"]
+        if _alive(pid) and _is_ours(pid):
+            print(f"stopping {st['role']} pid={pid} ({st['address']})")
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+            deadline = time.time() + args.grace
+            while _alive(pid) and time.time() < deadline:
+                time.sleep(0.05)
+            if _alive(pid):
+                print(f"  pid {pid} did not exit in {args.grace}s; SIGKILL")
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            stopped += 1
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    print(f"stopped {stopped} process(es)" if stopped else "nothing to stop")
+    return 0
+
+
+def add_stop_parser(sub) -> None:
+    sp = sub.add_parser("stop", help="stop all ray_tpu daemons started on this host")
+    sp.add_argument("--grace", type=float, default=10.0,
+                    help="seconds to wait for graceful exit before SIGKILL")
+
+

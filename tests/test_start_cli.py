@@ -1,0 +1,143 @@
+"""`ray_tpu start` bootstrap: a REAL two-host-shaped cluster formed from two
+separate OS processes — no `Cluster`, no shared Python state — then driven
+purely via `--address` (reference: `ray start --head` / `--address`,
+/root/reference/python/ray/scripts/scripts.py:682).
+
+The head and the joining node are each `python -m ray_tpu start` subprocesses
+(the CLI's detached mode, exactly what an operator types on each pod host);
+the driver is THIS process connecting by address. Token distribution rides
+RAYTPU_AUTH_TOKEN, the multi-host path.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+TOKEN = "start-cli-test-token"
+
+
+def _cli(env, *args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.fixture
+def cli_cluster(tmp_path):
+    env = dict(os.environ)
+    env["RAYTPU_STATE_DIR"] = str(tmp_path / "state")
+    env["RAYTPU_AUTH_TOKEN"] = TOKEN
+    addr_file = str(tmp_path / "head_addr")
+
+    head = _cli(env, "start", "--head", "--port", "0", "--num-cpus", "4",
+                "--no-tpu-autodetect", "--address-file", addr_file)
+    assert head.returncode == 0, f"head start failed:\n{head.stdout}\n{head.stderr}"
+    addr = open(addr_file).read().strip()
+
+    join = _cli(env, "start", f"--address={addr}", "--num-cpus", "4",
+                "--resources", '{"joiner": 1}', "--no-tpu-autodetect")
+    assert join.returncode == 0, f"join failed:\n{join.stdout}\n{join.stderr}"
+
+    yield addr, env
+
+    stop = _cli(env, "stop")
+    assert "stopped" in stop.stdout
+
+
+def test_start_cli_two_process_cluster(cli_cluster):
+    addr, env = cli_cluster
+    import ray_tpu as rt
+    from ray_tpu.core import api
+
+    rt.init(address=addr)  # token from RAYTPU_AUTH_TOKEN (multi-host path)
+    try:
+        # Both standalone daemons registered.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            s = api._cluster_state()
+            if sum(1 for n in s["nodes"].values() if n["state"] == "ALIVE") >= 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"joiner never registered: {s['nodes']}")
+
+        # Task — targeted at the joining process's node.
+        @rt.remote(resources={"joiner": 1})
+        def whoami():
+            return rt.get_runtime_context().node_id
+
+        @rt.remote
+        def double(x):
+            return 2 * x
+
+        joiner_node = rt.get(whoami.remote(), timeout=120)
+        assert rt.get(double.remote(21), timeout=120) == 42
+
+        # Actor pinned to the joiner, surviving across calls.
+        @rt.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(resources={"joiner": 0.5}).remote()
+        assert [rt.get(c.inc.remote(), timeout=120) for _ in range(3)] == [1, 2, 3]
+
+        # Placement group spanning both OS processes.
+        pg = rt.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+        assert pg.ready(timeout=120)
+        nodes = set(pg.bundle_nodes())
+        assert len(nodes) == 2 and joiner_node in nodes
+
+        # Train gang across the two daemons.
+        from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+        from ray_tpu import train
+
+        def loop(config):
+            ctx = train.get_context()
+            for i in range(2):
+                train.report({"step": i, "rank": ctx.get_world_rank()})
+
+        trainer = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 2}),
+            run_config=RunConfig(name="cli_gang"),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        assert result.metrics["step"] == 1
+    finally:
+        rt.shutdown()
+
+
+def test_stop_kills_recorded_processes(tmp_path):
+    env = dict(os.environ)
+    env["RAYTPU_STATE_DIR"] = str(tmp_path / "state")
+    env["RAYTPU_AUTH_TOKEN"] = TOKEN
+    addr_file = str(tmp_path / "addr")
+    head = _cli(env, "start", "--head", "--port", "0", "--num-cpus", "1",
+                "--no-tpu-autodetect", "--address-file", addr_file)
+    assert head.returncode == 0, head.stderr
+    state_dir = tmp_path / "state"
+    recs = [json.load(open(state_dir / f)) for f in os.listdir(state_dir)
+            if f.startswith("proc-")]
+    assert len(recs) == 1 and recs[0]["role"] == "head"
+    pid = recs[0]["pid"]
+    _cli(env, "stop")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(f"head pid {pid} still alive after stop")
+    assert not [f for f in os.listdir(state_dir) if f.startswith("proc-")]
