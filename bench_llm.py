@@ -100,13 +100,69 @@ def engine_phase():
     print("ENGINE_RESULT " + json.dumps(out), flush=True)
 
 
+def _probe_backend():
+    """Ambient accelerator seen by a FRESH process (the driver here pins
+    itself to CPU so the replica worker can claim the chip)."""
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.default_backend(), jax.devices()[0].device_kind)"],
+        capture_output=True, text=True, timeout=300,
+    )
+    on_tpu = probe.stdout.strip().startswith("tpu")
+    return on_tpu, probe.stdout.strip().split(" ", 1)[-1] if on_tpu else "cpu"
+
+
+def _serving_config(on_tpu: bool):
+    """(model_config, n_requests, prompt_len, max_tokens, slots, buckets) —
+    ONE table shared by every serving phase so they measure the same model."""
+    if on_tpu:
+        return (dict(vocab_size=32_000, d_model=1024, n_layers=12, n_heads=16,
+                     n_kv_heads=4, d_ff=4096, max_seq_len=2048, attention_impl="auto"),
+                32, 512, 64, 32, (128, 256, 512, 1024))
+    return (dict(vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                 d_ff=128, max_seq_len=256, attention_impl="reference"),
+            4, 32, 8, 2, (32, 64))
+
+
+def _sse_request(port, path, body: bytes, is_first_data):
+    """Raw-socket POST; parse the chunked SSE reply. Returns (ttfb, chunks):
+    ttfb = seconds to the first chunk matching is_first_data."""
+    import socket
+
+    t0 = time.perf_counter()
+    s = socket.create_connection(("127.0.0.1", port), timeout=600)
+    s.sendall(
+        (f"POST {path} HTTP/1.1\r\nhost: x\r\ncontent-length: {len(body)}\r\n\r\n").encode()
+        + body
+    )
+    f = s.makefile("rb")
+    status = f.readline()
+    assert b"200" in status, status
+    while True:  # headers
+        if f.readline() in (b"\r\n", b""):
+            break
+    ttfb = None
+    chunks = []
+    while True:  # chunked body; first matching chunk = client TTFT
+        size = int(f.readline().strip(), 16)
+        if size == 0:
+            f.readline()
+            break
+        data = f.read(size)
+        f.read(2)
+        if ttfb is None and is_first_data(data):
+            ttfb = time.perf_counter() - t0
+        chunks.append(data)
+    s.close()
+    return ttfb, chunks, time.perf_counter() - t0
+
+
 def serve_phase():
     # Pin the DRIVER to CPU before jax initializes any backend; the replica
     # worker (separate process) inherits the ambient env and claims the TPU.
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    import socket
     import threading
 
     import numpy as np
@@ -115,23 +171,8 @@ def serve_phase():
     from ray_tpu import serve
     from ray_tpu.llm import build_llm_app
 
-    probe = subprocess.run(
-        [sys.executable, "-c",
-         "import jax; print(jax.default_backend(), jax.devices()[0].device_kind)"],
-        capture_output=True, text=True, timeout=300,
-    )
-    on_tpu = probe.stdout.strip().startswith("tpu")
-    device_kind = probe.stdout.strip().split(" ", 1)[-1] if on_tpu else "cpu"
-    if on_tpu:
-        model = dict(vocab_size=32_000, d_model=1024, n_layers=12, n_heads=16,
-                     n_kv_heads=4, d_ff=4096, max_seq_len=2048, attention_impl="auto")
-        n_requests, prompt_len, max_tokens, slots = 32, 512, 64, 32
-        buckets = (128, 256, 512, 1024)
-    else:
-        model = dict(vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
-                     d_ff=128, max_seq_len=256, attention_impl="reference")
-        n_requests, prompt_len, max_tokens, slots = 4, 32, 8, 2
-        buckets = (32, 64)
+    on_tpu, device_kind = _probe_backend()
+    model, n_requests, prompt_len, max_tokens, slots, buckets = _serving_config(on_tpu)
 
     rt.init(num_cpus=8)
     serve.start()
@@ -148,34 +189,13 @@ def serve_phase():
     def one_request(out, idx):
         toks = rng.integers(0, model["vocab_size"], prompt_len).tolist()
         body = json.dumps({"tokens": toks, "max_tokens": max_tokens, "stream": True}).encode()
-        t0 = time.perf_counter()
-        s = socket.create_connection(("127.0.0.1", port), timeout=600)
-        s.sendall(
-            (f"POST /llm HTTP/1.1\r\nhost: x\r\ncontent-length: {len(body)}\r\n\r\n").encode()
-            + body
-        )
-        f = s.makefile("rb")
-        status = f.readline()
-        assert b"200" in status, status
-        while True:  # headers
-            if f.readline() in (b"\r\n", b""):
-                break
-        ttfb = None
+        ttfb, chunks, wall = _sse_request(port, "/llm", body, lambda d: b"data:" in d)
         n_tokens = 0
-        while True:  # chunked body; first data chunk = client TTFT
-            size = int(f.readline().strip(), 16)
-            if size == 0:
-                f.readline()
-                break
-            data = f.read(size)
-            f.read(2)
-            if ttfb is None and b"data:" in data:
-                ttfb = time.perf_counter() - t0
+        for data in chunks:
             for line in data.decode().split("\n\n"):
                 if line.startswith("data: ") and line != "data: [DONE]":
                     n_tokens += len(json.loads(line[6:]).get("new_tokens", []))
-        s.close()
-        out[idx] = (ttfb, n_tokens, time.perf_counter() - t0)
+        out[idx] = (ttfb, n_tokens, wall)
 
     # Unloaded: one isolated request.
     res: dict = {}
@@ -212,10 +232,84 @@ def serve_phase():
     rt.shutdown()
 
 
+def openai_phase():
+    """Client-level TEXT serving: tokens/s + TTFT observed by raw socket
+    clients speaking the OpenAI /v1/completions SSE protocol (tokenize ->
+    engine -> detokenize -> SSE), the full path a real client exercises."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import threading
+
+    import numpy as np
+
+    import ray_tpu as rt
+    from ray_tpu import serve
+    from ray_tpu.llm import build_openai_app
+
+    on_tpu, device_kind = _probe_backend()
+    model, n_requests, prompt_len, max_tokens, slots, buckets = _serving_config(on_tpu)
+
+    rt.init(num_cpus=8)
+    serve.start()
+    app = build_openai_app(
+        model_config=model,
+        engine_config={"max_slots": slots, "max_seq": model["max_seq_len"],
+                       "prefill_buckets": buckets},
+        warmup_buckets=(prompt_len,),
+        model_name="bench",
+    )
+    serve.run(app, name="bench_oai", route_prefix="/", timeout_s=1200)
+    port = serve.http_port()
+    rng = np.random.default_rng(0)
+    # ~1 token/byte with the byte-level tokenizer: prompt_len ASCII chars
+    # (+bos) lands in the same prefill bucket as the token-level phase.
+    letters = np.array(list("abcdefghijklmnopqrstuvwxyz "))
+
+    def one_request(out, idx):
+        prompt = "".join(rng.choice(letters, prompt_len - 1))
+        body = json.dumps({
+            "model": "bench", "prompt": prompt, "max_tokens": max_tokens,
+            "stream": True, "ignore_eos": True,
+        }).encode()
+        ttfb, _chunks, wall = _sse_request(
+            port, "/v1/completions", body, lambda d: b'"text"' in d
+        )
+        out[idx] = (ttfb, wall)
+
+    res: dict = {}
+    one_request(res, "warm")
+    one_request(res, "unloaded")
+    threads = [threading.Thread(target=one_request, args=(res, i)) for i in range(n_requests)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    for i in range(n_requests):
+        assert res[i][0] is not None, f"request {i} saw no text chunk: {res[i]!r}"
+    ttfts = sorted(res[i][0] for i in range(n_requests))
+    out = {
+        # ignore_eos guarantees every request decodes exactly max_tokens.
+        "client_tokens_per_sec": round(n_requests * max_tokens / wall, 1),
+        "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4),
+        "ttft_unloaded_s": round(float(res["unloaded"][0]), 4),
+        "requests": n_requests,
+        "max_tokens": max_tokens,
+        "total_wall_s": round(wall, 3),
+        "backend": "tpu" if on_tpu else "cpu",
+        "device_kind": device_kind,
+    }
+    print("OPENAI_RESULT " + json.dumps(out), flush=True)
+    serve.shutdown()
+    rt.shutdown()
+
+
 def main():
     here = os.path.dirname(os.path.abspath(__file__))
     results = {}
-    for phase in ("engine", "serve"):
+    for phase in ("engine", "serve", "openai"):
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), phase],
             capture_output=True, text=True, timeout=3600,
@@ -240,6 +334,7 @@ def main():
         "detail": {
             "engine": engine_r,
             "serve": serve_r,
+            "openai": results["openai"],
             "note": "serve phase co-locates 32 client threads + HTTP proxy + "
                     "replica process on this host's ONE cpu core; the "
                     "engine->client gap is host-side contention, not engine "
@@ -258,5 +353,7 @@ if __name__ == "__main__":
         engine_phase()
     elif len(sys.argv) > 1 and sys.argv[1] == "serve":
         serve_phase()
+    elif len(sys.argv) > 1 and sys.argv[1] == "openai":
+        openai_phase()
     else:
         main()

@@ -110,6 +110,18 @@ class Config:
     def to_dict(self) -> dict:
         return {f.name: getattr(self, f.name) for f in fields(self)}
 
+    # Fields that are NODE identity, not cluster policy: adopt_cluster
+    # preserves the local value when a head pushes its config down.
+    PER_NODE_FIELDS = ("node_ip",)
+
+    def adopt_cluster(self, d: dict) -> "Config":
+        """Adopt the head's cluster-wide config, keeping this process's
+        per-node fields (every daemon/worker calls this at registration)."""
+        cfg = Config.from_dict(d)
+        for f in self.PER_NODE_FIELDS:
+            setattr(cfg, f, getattr(self, f))
+        return cfg
+
     @classmethod
     def from_dict(cls, d: dict) -> "Config":
         cfg = cls()

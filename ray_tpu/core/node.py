@@ -184,11 +184,7 @@ class NodeDaemon:
                 "actors": actors,
             },
         )
-        # Adopt the head's cluster config, but node_ip is NODE identity
-        # (each host binds its own routable IP) — never the head's.
-        own_ip = self.config.node_ip
-        self.config = Config.from_dict(reply["config"])
-        self.config.node_ip = own_ip
+        self.config = self.config.adopt_cluster(reply["config"])
 
     async def _heartbeat_loop(self):
         while True:

@@ -375,9 +375,7 @@ class CoreWorker:
         if self.mode == "worker":
             reply = await self.daemon.call("register_worker", {"worker_id": self.worker_id, "address": self.address})
             self.node_id = reply["node_id"]
-            own_ip = self.config.node_ip  # node identity, not cluster config
-            self.config = Config.from_dict(reply["config"])
-            self.config.node_ip = own_ip
+            self.config = self.config.adopt_cluster(reply["config"])
             if self.store is not None:
                 # The store client predates the config push: re-apply
                 # settings that change ITS behavior (a worker without the

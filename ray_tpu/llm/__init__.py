@@ -10,5 +10,12 @@ between steps.
 """
 from ray_tpu.llm.engine import EngineConfig, LLMEngine
 from ray_tpu.llm.deployment import LLMServer, build_llm_app
+from ray_tpu.llm.openai import OpenAIServer, build_openai_app
+from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.llm.tokenizer import HFTokenizer, Tokenizer, load_tokenizer
 
-__all__ = ["EngineConfig", "LLMEngine", "LLMServer", "build_llm_app"]
+__all__ = [
+    "EngineConfig", "LLMEngine", "LLMServer", "build_llm_app",
+    "OpenAIServer", "build_openai_app", "SamplingParams",
+    "Tokenizer", "HFTokenizer", "load_tokenizer",
+]

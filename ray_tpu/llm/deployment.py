@@ -19,6 +19,16 @@ from collections import deque
 from typing import Optional
 
 
+def _coerce_sampling(sampling):
+    """Accept a SamplingParams, a kwargs dict (the over-the-wire form), or
+    None."""
+    if sampling is None or not isinstance(sampling, dict):
+        return sampling
+    from ray_tpu.llm.sampling import SamplingParams
+
+    return SamplingParams(**sampling)
+
+
 class LLMServer:
     """Serve-deployable callable: hosts an LLMEngine + stepping thread.
 
@@ -86,12 +96,15 @@ class LLMServer:
         self._counter += 1
         return f"r{self._counter}-{time.monotonic_ns()}"
 
-    def generate(self, tokens, max_tokens: int = 64, timeout_s: float = 300.0) -> dict:
+    def generate(self, tokens, max_tokens: int = 64, timeout_s: float = 300.0,
+                 sampling=None) -> dict:
         """Blocking generate; safe to call from many router threads at once —
-        the engine batches all in-flight requests per decode iteration."""
+        the engine batches all in-flight requests per decode iteration.
+        sampling: per-request SamplingParams (or kwargs dict for one)."""
+        sampling = _coerce_sampling(sampling)
         with self._cond:
             rid = self._new_rid()
-            self.engine.add_request(rid, tokens, max_tokens)
+            self.engine.add_request(rid, tokens, max_tokens, sampling=sampling)
             self._cond.notify_all()
             deadline = time.time() + timeout_s
             while rid not in self._done:
@@ -101,15 +114,17 @@ class LLMServer:
                 self._cond.wait(timeout=min(remaining, 1.0))
             return self._done.pop(rid)
 
-    def generate_stream(self, tokens, max_tokens: int = 64, timeout_s: float = 300.0):
+    def generate_stream(self, tokens, max_tokens: int = 64, timeout_s: float = 300.0,
+                        sampling=None):
         """Streaming generate: yields one event dict per engine step that
         produced tokens for this request ({"new_tokens": [...], "ttft_s":
         float|None, "finished": bool}, final event carries "tokens"). Each
         event leaves this replica the moment the decode block lands on host."""
+        sampling = _coerce_sampling(sampling)
         with self._cond:
             rid = self._new_rid()
             self._streams[rid] = deque()
-            self.engine.add_request(rid, tokens, max_tokens)
+            self.engine.add_request(rid, tokens, max_tokens, sampling=sampling)
             self._cond.notify_all()
         deadline = time.time() + timeout_s
         finished = False
@@ -142,16 +157,17 @@ class LLMServer:
                     self._aborts.add(rid)
                     self._cond.notify_all()
 
-    def _sse_stream(self, tokens, max_tokens: int):
+    def _sse_stream(self, tokens, max_tokens: int, sampling=None):
         """OpenAI-style SSE frames (reference: llm ingress SSE): one
         `data: {json}` frame per event, then `data: [DONE]`."""
-        for ev in self.generate_stream(tokens, max_tokens):
+        for ev in self.generate_stream(tokens, max_tokens, sampling=sampling):
             yield f"data: {json.dumps(ev)}\n\n"
         yield "data: [DONE]\n\n"
 
     def __call__(self, request):
         """Accepts a serve HTTP Request (JSON body) or a plain dict:
-        {"tokens": [...], "max_tokens": N, "stream": bool}. With
+        {"tokens": [...], "max_tokens": N, "stream": bool, plus optional
+        per-request sampling: temperature/top_p/top_k/ignore_eos}. With
         stream=true returns a generator of SSE frames (the proxy sends it
         chunked as text/event-stream); otherwise blocks and returns the
         full completion."""
@@ -161,9 +177,21 @@ class LLMServer:
             payload = request
         tokens = payload["tokens"]
         max_tokens = int(payload.get("max_tokens", 64))
+        sampling = {
+            k: payload[k]
+            for k in ("temperature", "top_p", "top_k", "ignore_eos")
+            if k in payload
+        }
+        if sampling:
+            # A partial dict must not silently flip temperature to greedy:
+            # absent keys inherit the engine's configured default.
+            sampling.setdefault("temperature", self.engine.ec.temperature)
+            sampling = dict(sampling, max_tokens=max_tokens)
+        else:
+            sampling = None
         if payload.get("stream"):
-            return self._sse_stream(tokens, max_tokens)
-        return self.generate(tokens, max_tokens)
+            return self._sse_stream(tokens, max_tokens, sampling)
+        return self.generate(tokens, max_tokens, sampling=sampling)
 
     def check_health(self) -> bool:
         return self._thread.is_alive()

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.llm.sampling import SamplingParams, sample_batch
 from ray_tpu.models.transformer import TransformerConfig, _dense_ffn, _rms_norm, _rope, init_params
 from ray_tpu.ops.paged_attention import paged_attention
 
@@ -95,6 +96,8 @@ class _Slot:
     n_generated: int = 0  # dispatched count (values may still be on device)
     arrived_at: float = 0.0
     first_token_at: Optional[float] = None
+    stop_ids: tuple = ()  # per-request stop tokens (on top of engine eos)
+    ignore_eos: bool = False
 
 
 def _attn_proj(h, lp, cfg, dt):
@@ -154,10 +157,9 @@ def _decode_layer_dense(x, lp, ck, cv, cfg: TransformerConfig, lengths):
     return x, ck, cv
 
 
-def _sample(logits, temperature, key):
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+def _sample1(logits, temp, top_p, top_k, key):
+    """Single-row wrapper over the batched per-request sampler."""
+    return sample_batch(logits[None], temp[None], top_p[None], top_k[None], key)[0]
 
 
 class LLMEngine:
@@ -216,6 +218,16 @@ class LLMEngine:
         self.d_lengths = jnp.zeros(B, jnp.int32)
         self.d_last = jnp.zeros(B, jnp.int32)
         self.slots: list[Optional[_Slot]] = [None] * B
+        # Per-slot sampling params (vLLM-style per-request SamplingParams,
+        # llm/sampling.py): host copies set at admission, device mirrors ride
+        # into every prefill/decode program as [B] arrays — a mixed batch
+        # samples each row under its own request's params.
+        self.samp_temps = np.full(B, self.ec.temperature, np.float32)
+        self.samp_top_ps = np.ones(B, np.float32)
+        self.samp_top_ks = np.zeros(B, np.int32)
+        self.d_temps = jnp.asarray(self.samp_temps)
+        self.d_top_ps = jnp.asarray(self.samp_top_ps)
+        self.d_top_ks = jnp.asarray(self.samp_top_ks)
         self.waiting: deque = deque()
         self._key = jax.random.PRNGKey(self.ec.seed + 1)
         self._prefill_jit: dict[int, Any] = {}
@@ -248,7 +260,7 @@ class LLMEngine:
         return math.ceil(total / self.ec.page_size)
 
     # -- jitted programs ---------------------------------------------------
-    def _prefill_impl(self, params, k_pages, v_pages, tokens, length, page_idxs, key):
+    def _prefill_impl(self, params, k_pages, v_pages, tokens, length, page_idxs, key, temp, top_p, top_k):
         """tokens: [P] (padded to the bucket); page_idxs: [P // ps] page ids
         (trailing entries may be 0 = dead sink). Writes K/V pages, returns
         the first generated token + updated pools."""
@@ -285,10 +297,10 @@ class LLMEngine:
         x = _rms_norm(x, params["final_norm"])
         last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
         logits = last @ params["lm_head"].astype(cfg.dtype)
-        tok = _sample(logits.astype(jnp.float32), self.ec.temperature, key)
+        tok = _sample1(logits.astype(jnp.float32), temp, top_p, top_k, key)
         return k_pages, v_pages, tok
 
-    def _decode_impl(self, params, k_pages, v_pages, last_tokens, lengths, page_tables, n_steps, key):
+    def _decode_impl(self, params, k_pages, v_pages, last_tokens, lengths, page_tables, n_steps, key, temps, top_ps, top_ks):
         """n_steps tokens for every slot in ONE device program (outer scan
         over steps, inner scan over layers): one host round trip per block.
         Returns (k_pages, v_pages, toks [n_steps, B], last', lengths')."""
@@ -329,7 +341,7 @@ class LLMEngine:
             x, (kp, vp) = jax.lax.scan(scan_fn, x, (params["layers"], kp, vp))
             x = _rms_norm(x, params["final_norm"])
             logits = jnp.einsum("bsd,dv->bv", x, params["lm_head"].astype(cfg.dtype))
-            toks = _sample(logits.astype(jnp.float32), self.ec.temperature, step_key)
+            toks = sample_batch(logits.astype(jnp.float32), temps, top_ps, top_ks, step_key)
             return (kp, vp, toks, lens + 1), toks
 
         keys = jax.random.split(key, n_steps)
@@ -338,7 +350,7 @@ class LLMEngine:
         )
         return k_pages, v_pages, toks, last, lengths
 
-    def _prefill_batch_impl(self, params, k_pages, v_pages, tokens, lengths, third, key):
+    def _prefill_batch_impl(self, params, k_pages, v_pages, tokens, lengths, third, key, temps, top_ps, top_ks):
         """Prefill k requests of one length bucket in ONE device program
         (scan over requests around the single-request body): one dispatch per
         admitted group instead of one per request — on a remote/tunneled chip
@@ -351,16 +363,16 @@ class LLMEngine:
 
         def scan_req(carry, xs):
             kp, vp = carry
-            toks_i, len_i, third_i, key_i = xs
-            kp, vp, tok = impl(params, kp, vp, toks_i, len_i, third_i, key_i)
+            toks_i, len_i, third_i, key_i, t_i, p_i, k_i = xs
+            kp, vp, tok = impl(params, kp, vp, toks_i, len_i, third_i, key_i, t_i, p_i, k_i)
             return (kp, vp), tok
 
         (k_pages, v_pages), toks = jax.lax.scan(
-            scan_req, (k_pages, v_pages), (tokens, lengths, third, keys)
+            scan_req, (k_pages, v_pages), (tokens, lengths, third, keys, temps, top_ps, top_ks)
         )
         return k_pages, v_pages, toks  # toks: [k]
 
-    def _prefill_impl_dense(self, params, cache_k, cache_v, tokens, length, slot, key):
+    def _prefill_impl_dense(self, params, cache_k, cache_v, tokens, length, slot, key, temp, top_p, top_k):
         """Dense layout: K/V land in one dynamic_update_slice at the slot row."""
         cfg = self.cfg
         P = tokens.shape[0]
@@ -379,10 +391,10 @@ class LLMEngine:
         x = _rms_norm(x, params["final_norm"])
         last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
         logits = last @ params["lm_head"].astype(cfg.dtype)
-        tok = _sample(logits.astype(jnp.float32), self.ec.temperature, key)
+        tok = _sample1(logits.astype(jnp.float32), temp, top_p, top_k, key)
         return cache_k, cache_v, tok
 
-    def _decode_impl_dense(self, params, cache_k, cache_v, last_tokens, lengths, n_steps, key):
+    def _decode_impl_dense(self, params, cache_k, cache_v, last_tokens, lengths, n_steps, key, temps, top_ps, top_ks):
         """Dense layout: n_steps for every slot in one program; attention is
         the fused einsum over each slot's contiguous [S] row."""
         cfg = self.cfg
@@ -399,7 +411,7 @@ class LLMEngine:
             x, (ck, cv) = jax.lax.scan(scan_fn, x, (params["layers"], ck, cv))
             x = _rms_norm(x, params["final_norm"])
             logits = jnp.einsum("bsd,dv->bv", x, params["lm_head"].astype(cfg.dtype))
-            toks = _sample(logits.astype(jnp.float32), self.ec.temperature, step_key)
+            toks = sample_batch(logits.astype(jnp.float32), temps, top_ps, top_ks, step_key)
             return (ck, cv, toks, lens + 1), toks
 
         keys = jax.random.split(key, n_steps)
@@ -444,7 +456,9 @@ class LLMEngine:
                 else:
                     third = jnp.zeros(k, jnp.int32)  # slot 0 (reset below)
                 self.k_pages, self.v_pages, td = self._prefill(b, k)(
-                    self.params, self.k_pages, self.v_pages, toks, lens, third, key
+                    self.params, self.k_pages, self.v_pages, toks, lens, third, key,
+                    jnp.zeros(k, jnp.float32), jnp.ones(k, jnp.float32),
+                    jnp.zeros(k, jnp.int32),
                 )
                 # The admit path's per-group mirror updates are their own tiny
                 # jitted programs, one shape variant per k — compile them here
@@ -458,11 +472,13 @@ class LLMEngine:
                 out = self._decode_jit(
                     self.params, self.k_pages, self.v_pages, self.d_last,
                     self.d_lengths, self.d_page_tables, n, key,
+                    self.d_temps, self.d_top_ps, self.d_top_ks,
                 )
             else:
                 out = self._decode_jit(
                     self.params, self.k_pages, self.v_pages, self.d_last,
                     self.d_lengths, n, key,
+                    self.d_temps, self.d_top_ps, self.d_top_ks,
                 )
             self.k_pages, self.v_pages = out[0], out[1]
             jax.device_get(out[2])
@@ -471,15 +487,25 @@ class LLMEngine:
         self.d_last = jnp.zeros(self.ec.max_slots, jnp.int32)
 
     # -- request lifecycle -------------------------------------------------
-    def add_request(self, req_id: str, tokens, max_tokens: int = 64):
+    def add_request(self, req_id: str, tokens, max_tokens: int = 64,
+                    sampling: SamplingParams | None = None):
+        """Queue a request. `sampling` carries the per-request decode params
+        (temperature/top_p/top_k/max_tokens/stop_token_ids); without it the
+        engine-global defaults (EngineConfig.temperature, greedy top) apply."""
+        if sampling is None:
+            sampling = SamplingParams(
+                temperature=self.ec.temperature, max_tokens=max_tokens
+            )
         if len(tokens) >= self.ec.max_seq:
             raise ValueError(f"prompt length {len(tokens)} >= max_seq {self.ec.max_seq}")
-        need = self._pages_needed(len(tokens), max_tokens)
+        need = self._pages_needed(len(tokens), sampling.max_tokens)
         if self.paged and need > self.ec.total_pages - 1:
             raise ValueError(
                 f"request needs {need} pages > pool size {self.ec.total_pages - 1}"
             )
-        self.waiting.append((req_id, np.asarray(tokens, np.int32), max_tokens, time.perf_counter()))
+        self.waiting.append(
+            (req_id, np.asarray(tokens, np.int32), sampling, time.perf_counter())
+        )
 
     def abort(self, req_id: str) -> None:
         """Drop a request whose consumer went away: dequeue it, or free its
@@ -520,8 +546,8 @@ class LLMEngine:
         for i in range(self.ec.max_slots):
             if not self.waiting or self.slots[i] is not None:
                 continue
-            req_id, tokens, max_tokens, arrived = self.waiting[0]
-            need = self._pages_needed(len(tokens), max_tokens)
+            req_id, tokens, sp, arrived = self.waiting[0]
+            need = self._pages_needed(len(tokens), sp.max_tokens)
             if need > len(self.free_pages):
                 break  # head-of-line blocks until pages free (FIFO fairness)
             self.waiting.popleft()
@@ -529,14 +555,18 @@ class LLMEngine:
             P = len(tokens)
             bucket = next(b for b in self.buckets if b >= P)
             self.slots[i] = _Slot(
-                req_id=req_id, max_tokens=max_tokens, pages=pages,
+                req_id=req_id, max_tokens=sp.max_tokens, pages=pages,
                 n_generated=1, arrived_at=arrived,
+                stop_ids=tuple(sp.stop_token_ids), ignore_eos=sp.ignore_eos,
             )
+            self.samp_temps[i] = sp.temperature
+            self.samp_top_ps[i] = sp.top_p
+            self.samp_top_ks[i] = sp.top_k
             self.lengths[i] = P
             row = np.zeros(self.ppseq, np.int32)
             row[: len(pages)] = pages
             self.page_tables[i] = row
-            admitted.append((i, req_id, tokens, bucket, max_tokens, arrived))
+            admitted.append((i, req_id, tokens, bucket, sp.max_tokens, arrived))
         # 2. dispatch prefill groups back-to-back (async), fetch in order so
         # each group's TTFT is its own completion time.
         by_bucket: dict[int, list] = {}
@@ -564,12 +594,18 @@ class LLMEngine:
                 self.k_pages, self.v_pages, toks_dev = self._prefill(bucket, k)(
                     self.params, self.k_pages, self.v_pages,
                     jnp.asarray(padded), jnp.asarray(lens), third, sub,
+                    jnp.asarray(self.samp_temps[idxs]),
+                    jnp.asarray(self.samp_top_ps[idxs]),
+                    jnp.asarray(self.samp_top_ks[idxs]),
                 )
                 self.d_lengths = self.d_lengths.at[idx_arr].set(jnp.asarray(lens))
                 self.d_last = self.d_last.at[idx_arr].set(toks_dev)
                 dispatched.append((chunk, toks_dev))
         if admitted:
             self.d_page_tables = jnp.asarray(self.page_tables)
+            self.d_temps = jnp.asarray(self.samp_temps)
+            self.d_top_ps = jnp.asarray(self.samp_top_ps)
+            self.d_top_ks = jnp.asarray(self.samp_top_ks)
         # Fetch per group, in dispatch order: group g's fetch returns while
         # g+1 still runs on device (async dispatch), so TTFT is per-group.
         for chunk, toks_dev in dispatched:
@@ -609,11 +645,13 @@ class LLMEngine:
                         (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
                             self.params, self.k_pages, self.v_pages, self.d_last,
                             self.d_lengths, self.d_page_tables, n, sub,
+                            self.d_temps, self.d_top_ps, self.d_top_ks,
                         )
                     else:
                         (self.k_pages, self.v_pages, toks, self.d_last, self.d_lengths) = self._decode_jit(
                             self.params, self.k_pages, self.v_pages, self.d_last,
                             self.d_lengths, n, sub,
+                            self.d_temps, self.d_top_ps, self.d_top_ks,
                         )
                     for i in active:
                         self.slots[i].n_generated += n
@@ -663,7 +701,8 @@ class LLMEngine:
         slot = self.slots[i]
         done = (
             len(slot.emitted) >= slot.max_tokens
-            or (self.ec.eos_id >= 0 and slot.emitted[-1] == self.ec.eos_id)
+            or (not slot.ignore_eos and self.ec.eos_id >= 0 and slot.emitted[-1] == self.ec.eos_id)
+            or slot.emitted[-1] in slot.stop_ids
             or int(self.lengths[i]) + 1 >= self.ec.max_seq
         )
         if done:

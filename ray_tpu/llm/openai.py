@@ -1,0 +1,282 @@
+"""OpenAI-compatible text ingress for the TPU LLM engine.
+
+Role-equivalent to the reference's OpenAI-compatible serve ingress
+(/root/reference/python/ray/llm/_internal/serve/core/ingress/ingress.py:145 —
+`/v1/chat/completions` + `/v1/completions` + `/v1/models` over FastAPI/vLLM).
+Redesigned for this stack: one serve deployment that owns the tokenizer AND
+the engine (no separate router process), speaking the proxy's native
+Request/SSE protocol. Text in, text out:
+
+    curl http://host:port/v1/chat/completions -d '{
+        "model": "...", "messages": [{"role": "user", "content": "hi"}],
+        "stream": true, "temperature": 0.7, "top_p": 0.9}'
+
+Per-request sampling rides SamplingParams into the engine, so one continuous
+batch mixes greedy and sampled requests. Stop STRINGS are applied here at
+the text layer (with holdback so a stop sequence split across decode blocks
+never leaks to the client); stop token ids and eos retire in the engine.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from ray_tpu.llm.deployment import LLMServer
+from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.llm.tokenizer import load_tokenizer
+
+
+def _as_tuple(x) -> tuple:
+    if x is None:
+        return ()
+    if isinstance(x, str):
+        return (x,)
+    return tuple(x)
+
+
+class _StopTruncator:
+    """Incremental detokenizer + stop-string application for one stream.
+
+    Feeds on token ids, emits text deltas. Holds back (a) trailing bytes of
+    an incomplete UTF-8 character (byte-level BPE can split a char across
+    tokens) and (b) any suffix that is a prefix of a stop string, so a stop
+    sequence arriving across two decode blocks is still caught before any
+    part of it reaches the client."""
+
+    def __init__(self, tok, stops: tuple):
+        self.tok = tok
+        self.stops = tuple(s for s in stops if s)
+        self.ids: list[int] = []
+        self.emitted = 0  # chars of `text` already released
+        self.stopped = False
+
+    def feed(self, new_ids) -> str:
+        """Returns the text delta safe to emit for these new token ids."""
+        if self.stopped:
+            return ""
+        self.ids.extend(int(t) for t in new_ids)
+        text = self.tok.decode(self.ids)
+        # Check stops against the full text (stop may span block boundary).
+        cut = None
+        for s in self.stops:
+            pos = text.find(s, max(0, self.emitted - max(len(x) for x in self.stops)))
+            if pos != -1 and (cut is None or pos < cut):
+                cut = pos
+        if cut is not None:
+            self.stopped = True
+            delta = text[self.emitted:cut]
+            self.emitted = cut
+            return delta
+        # Hold back partial UTF-8 (shows as U+FFFD at the tail) and possible
+        # stop-string prefixes.
+        hold = 0
+        while hold < len(text) and text[len(text) - 1 - hold] == "�":
+            hold += 1
+        safe_end = len(text) - hold
+        for s in self.stops:
+            for k in range(min(len(s) - 1, safe_end), 0, -1):
+                if text[:safe_end].endswith(s[:k]):
+                    safe_end -= k
+                    break
+        if safe_end <= self.emitted:
+            return ""
+        delta = text[self.emitted:safe_end]
+        self.emitted = safe_end
+        return delta
+
+    def flush(self) -> str:
+        """Release held-back text at end of stream (no stop ever completed)."""
+        if self.stopped:
+            return ""
+        text = self.tok.decode(self.ids)
+        while text.endswith("�"):
+            text = text[:-1]  # a split char at EOS can never complete
+        delta = text[self.emitted:]
+        self.emitted = len(text)
+        return delta
+
+
+class OpenAIServer:
+    """Serve deployment: OpenAI-compatible HTTP surface over an LLMEngine.
+
+    Routes (paths are relative to the app's route_prefix):
+      GET  /v1/models
+      POST /v1/completions        (prompt: str)
+      POST /v1/chat/completions   (messages: [{role, content}, ...])
+    Both POST routes accept stream, temperature, top_p, top_k, max_tokens,
+    stop (str | [str]), ignore_eos.
+    """
+
+    def __init__(self, model_config: dict, engine_config: Optional[dict] = None,
+                 tokenizer: Optional[str] = None, model_name: str = "ray-tpu-llm",
+                 warmup_buckets: Optional[tuple] = None,
+                 chat_template: Optional[str] = None):
+        self.tok = load_tokenizer(tokenizer)
+        self.model_name = model_name
+        self.created = int(time.time())
+        # "{role}: {content}" per message + a generation prompt — the
+        # fallback template shape; pass chat_template to override
+        # ({messages} is substituted with the formatted turns).
+        self.chat_template = chat_template or "{messages}assistant:"
+        ec = dict(engine_config or {})
+        if "eos_id" not in ec and self.tok.eos_id >= 0:
+            ec["eos_id"] = self.tok.eos_id
+        self._llm = LLMServer(model_config, ec, warmup_buckets=warmup_buckets)
+
+    # -- request plumbing --------------------------------------------------
+    def _error(self, status: int, msg: str, etype: str = "invalid_request_error"):
+        from ray_tpu.serve.proxy import HTTPResponse
+
+        return HTTPResponse(
+            status, json.dumps({"error": {"message": msg, "type": etype}})
+        )
+
+    def _sampling(self, body: dict) -> SamplingParams:
+        return SamplingParams(
+            temperature=float(body.get("temperature", 0.0)),
+            top_p=float(body.get("top_p", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+            max_tokens=int(body.get("max_tokens", 128)),
+            ignore_eos=bool(body.get("ignore_eos", False)),
+        )
+
+    def _chat_prompt(self, messages) -> str:
+        turns = "".join(f"{m.get('role', 'user')}: {m.get('content', '')}\n" for m in messages)
+        return self.chat_template.format(messages=turns)
+
+    def __call__(self, request):
+        if isinstance(request, dict):
+            # Handle-call convention (no HTTP): infer the route from the
+            # body shape — messages => chat, prompt => completions.
+            path = "/v1/chat/completions" if "messages" in request else "/v1/completions"
+            method = "POST"
+        else:
+            path = getattr(request, "path", "/")
+            method = getattr(request, "method", "POST")
+        if path.rstrip("/") == "/v1/models":
+            return {
+                "object": "list",
+                "data": [{"id": self.model_name, "object": "model",
+                          "created": self.created, "owned_by": "ray_tpu"}],
+            }
+        is_chat = path.rstrip("/") == "/v1/chat/completions"
+        if not is_chat and path.rstrip("/") != "/v1/completions":
+            return self._error(404, f"no route {path}")
+        if method != "POST":
+            return self._error(405, f"{method} not allowed on {path}")
+        try:
+            body = request.json() if not isinstance(request, dict) else request
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            if is_chat:
+                messages = body["messages"]
+                prompt = self._chat_prompt(messages)
+            else:
+                prompt = body["prompt"]
+                if not isinstance(prompt, str):
+                    raise ValueError("prompt must be a string")
+            sp = self._sampling(body)
+            stops = _as_tuple(body.get("stop"))
+        except (KeyError, ValueError, TypeError) as e:
+            return self._error(400, str(e))
+        prompt_ids = self.tok.encode(prompt, add_bos=True)
+        rid = f"{'chatcmpl' if is_chat else 'cmpl'}-{time.monotonic_ns():x}"
+        if body.get("stream"):
+            return self._stream(rid, is_chat, prompt_ids, sp, stops)
+        return self._complete(rid, is_chat, prompt_ids, sp, stops, len(prompt_ids))
+
+    # -- non-streaming -----------------------------------------------------
+    def _complete(self, rid, is_chat, prompt_ids, sp, stops, n_prompt):
+        out = self._llm.generate(prompt_ids, sampling=sp)
+        trunc = _StopTruncator(self.tok, stops)
+        text = trunc.feed(out["tokens"]) + trunc.flush()
+        finish = "stop" if (trunc.stopped or len(out["tokens"]) < sp.max_tokens) else "length"
+        usage = {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": len(out["tokens"]),
+            "total_tokens": n_prompt + len(out["tokens"]),
+        }
+        if is_chat:
+            return {
+                "id": rid, "object": "chat.completion", "created": int(time.time()),
+                "model": self.model_name,
+                "choices": [{"index": 0,
+                             "message": {"role": "assistant", "content": text},
+                             "finish_reason": finish}],
+                "usage": usage,
+            }
+        return {
+            "id": rid, "object": "text_completion", "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [{"index": 0, "text": text, "finish_reason": finish}],
+            "usage": usage,
+        }
+
+    # -- streaming ---------------------------------------------------------
+    def _chunk(self, rid, is_chat, delta_text, finish=None, first=False) -> str:
+        if is_chat:
+            delta = {}
+            if first:
+                delta["role"] = "assistant"
+            if delta_text:
+                delta["content"] = delta_text
+            choice = {"index": 0, "delta": delta, "finish_reason": finish}
+            obj = "chat.completion.chunk"
+        else:
+            choice = {"index": 0, "text": delta_text, "finish_reason": finish}
+            obj = "text_completion"
+        payload = {"id": rid, "object": obj, "created": int(time.time()),
+                   "model": self.model_name, "choices": [choice]}
+        return f"data: {json.dumps(payload)}\n\n"
+
+    def _stream(self, rid, is_chat, prompt_ids, sp, stops):
+        trunc = _StopTruncator(self.tok, stops)
+        first = True
+        n_out = 0
+        for ev in self._llm.generate_stream(prompt_ids, sampling=sp):
+            n_out += len(ev.get("new_tokens", ()))
+            delta = trunc.feed(ev.get("new_tokens", ()))
+            if delta or first:
+                yield self._chunk(rid, is_chat, delta, first=first)
+                first = False
+            if trunc.stopped or ev.get("finished"):
+                break
+        tail = trunc.flush()
+        if tail:
+            yield self._chunk(rid, is_chat, tail, first=first)
+            first = False
+        finish = "stop" if (trunc.stopped or n_out < sp.max_tokens) else "length"
+        yield self._chunk(rid, is_chat, "", finish=finish, first=first)
+        yield "data: [DONE]\n\n"
+
+    # -- serve integration -------------------------------------------------
+    def check_health(self) -> bool:
+        return self._llm.check_health()
+
+    def stats(self) -> dict:
+        return self._llm.stats()
+
+    def __raytpu_exit__(self):
+        self._llm.__raytpu_exit__()
+
+
+def build_openai_app(model_config: dict, engine_config: Optional[dict] = None,
+                     tokenizer: Optional[str] = None, model_name: str = "ray-tpu-llm",
+                     num_replicas: int = 1, max_ongoing_requests: Optional[int] = None,
+                     warmup_buckets: Optional[tuple] = None,
+                     ray_actor_options: Optional[dict] = None):
+    """OpenAI-compatible serving app; serve.run(...) it with a route_prefix
+    and POST /v1/chat/completions to the proxy port."""
+    from ray_tpu import serve
+    from ray_tpu.llm.engine import EngineConfig
+
+    slots = EngineConfig(**{k: v for k, v in (engine_config or {}).items()
+                            if k in EngineConfig.__dataclass_fields__}).max_slots
+    dep = serve.deployment(OpenAIServer).options(
+        name="openai_llm",
+        num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests or slots,
+        ray_actor_options=ray_actor_options or {},
+    )
+    return dep.bind(model_config, engine_config, tokenizer, model_name, warmup_buckets)

@@ -26,7 +26,7 @@ from ray_tpu.serve.handle import (
     DeploymentResponseGenerator,
 )
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
-from ray_tpu.serve.proxy import Request
+from ray_tpu.serve.proxy import HTTPResponse, Request
 
 __all__ = [
     "Application",
@@ -36,6 +36,7 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentResponseGenerator",
+    "HTTPResponse",
     "Request",
     "batch",
     "delete",

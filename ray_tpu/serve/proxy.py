@@ -20,6 +20,28 @@ from typing import Any, Optional
 from urllib.parse import parse_qs, urlsplit
 
 
+class HTTPResponse:
+    """Return one of these from a deployment's __call__ to control the HTTP
+    status/content type (the default mapping JSON-encodes any other return
+    value as 200). body: bytes or str."""
+
+    _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                405: "Method Not Allowed", 422: "Unprocessable Entity",
+                429: "Too Many Requests", 500: "Internal Server Error"}
+
+    def __init__(self, status: int, body, content_type: str = "application/json"):
+        self.status = int(status)
+        self.body = body.encode() if isinstance(body, str) else bytes(body)
+        self.content_type = content_type
+
+    @property
+    def status_line(self) -> str:
+        return f"{self.status} {self._REASONS.get(self.status, 'Status')}"
+
+    def __reduce__(self):
+        return (HTTPResponse, (self.status, self.body, self.content_type))
+
+
 class Request:
     """What an HTTP deployment's __call__ receives."""
 
@@ -334,6 +356,8 @@ class ProxyActor:
         if tag == "value":
             gen.close()
             result = first
+            if isinstance(result, HTTPResponse):
+                return result.status_line, result.body, result.content_type
             if isinstance(result, bytes):
                 return "200 OK", result, "application/octet-stream"
             if isinstance(result, str):
