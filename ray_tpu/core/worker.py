@@ -1656,6 +1656,7 @@ class CoreWorker:
         except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
             # OSError covers raw transport errors (ConnectionResetError from
             # writer.drain()) that the rpc layer does not wrap.
+            failed_addr = entry.get("addr") or ""
             entry["conn"] = None
             entry["addr"] = ""
             for fut in [f for _, f in sent]:
@@ -1675,7 +1676,7 @@ class CoreWorker:
             for spec in specs:
                 if getattr(spec.options, "max_task_retries", 0) > 0:
                     try:
-                        await self._push_actor_task(spec, attempt=1)
+                        await self._push_actor_task(spec, attempt=1, bad_addr=failed_addr)
                     except ActorDiedError as e2:
                         self._fail_task_returns(spec, e2)
                 else:
@@ -1720,14 +1721,15 @@ class CoreWorker:
             reply = await fut
         except ActorDiedError as e:
             self._fail_task_returns(spec, e)
-        except (rpc.ConnectionLost, rpc.RpcError) as e:
+        except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
             # Connection dropped mid-flight: the task may or may not have
             # executed. Resend ONLY if the user opted into retries
             # (max_task_retries > 0) — otherwise at-most-once wins.
+            bad_addr = entry.get("addr") or ""
             entry["conn"] = None
             entry["addr"] = ""
             if getattr(spec.options, "max_task_retries", 0) > 0:
-                await self._push_actor_task(spec, attempt=1)
+                await self._push_actor_task(spec, attempt=1, bad_addr=bad_addr)
             else:
                 self._fail_task_returns(
                     spec,
@@ -1738,26 +1740,44 @@ class CoreWorker:
         else:
             self._absorb_task_reply(spec, reply)
 
-    async def _push_actor_task(self, spec: TaskSpec, attempt: int = 0):
+    async def _push_actor_task(self, spec: TaskSpec, attempt: int = 0, bad_addr: str = ""):
         entry = self._actor_conns.get(spec.actor_id)
         if entry is None:
             entry = self._actor_conns[spec.actor_id] = {"addr": "", "conn": None}
         try:
             if entry["conn"] is None or entry["conn"].closed:
-                if not entry["addr"]:
+                if not entry["addr"] or entry["addr"] == bad_addr:
                     await self._refresh_actor_addr(spec.actor_id, entry)
+                    # Stale-address window: the controller may not have seen
+                    # the death yet and hands back the address that just
+                    # failed. Poll until the record moves — RESTARTING blocks
+                    # inside wait_actor_alive, a restarted incarnation gets a
+                    # NEW worker address, DEAD raises ActorDiedError.
+                    deadline = time.monotonic() + self.config.actor_creation_timeout_s
+                    while bad_addr and entry["addr"] == bad_addr:
+                        if time.monotonic() > deadline:
+                            raise ActorDiedError(
+                                f"actor {spec.actor_id.hex()[:8]} never left failed "
+                                f"address {bad_addr}"
+                            )
+                        await asyncio.sleep(self.config.task_retry_delay_s)
+                        await self._refresh_actor_addr(spec.actor_id, entry)
                 entry["conn"] = await self._peer_conn(entry["addr"])
             reply = await entry["conn"].call("push_actor_task", {"spec": spec})
             self._absorb_task_reply(spec, reply)
         except ActorDiedError as e:
             self._fail_task_returns(spec, e)
-        except (rpc.ConnectionLost, rpc.RpcError, KeyError) as e:
+        except (rpc.ConnectionLost, rpc.RpcError, KeyError, OSError) as e:
+            # OSError covers raw transport failures (ConnectionReset/BrokenPipe
+            # out of writer.drain) — anything escaping here would kill the
+            # retry task and leave the caller's ref unresolved forever.
+            failed = entry.get("addr") or bad_addr
             entry["conn"] = None
             entry["addr"] = ""
             max_task_retries = getattr(spec.options, "max_task_retries", 0)
             if attempt < max_task_retries:
                 await asyncio.sleep(self.config.task_retry_delay_s)
-                await self._push_actor_task(spec, attempt + 1)
+                await self._push_actor_task(spec, attempt + 1, bad_addr=failed)
             else:
                 self._fail_task_returns(
                     spec, ActorDiedError(f"actor {spec.actor_id.hex()[:8]} task {spec.method_name} failed: {e}")

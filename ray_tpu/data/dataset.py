@@ -44,11 +44,52 @@ class Dataset:
         return self._chain("map", fn)
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
-                    batch_size: Optional[int] = None) -> "Dataset":
-        # batch_size is advisory here: blocks are the batching unit (the
-        # reference re-batches too; we keep block==batch for zero re-slicing).
-        return self._chain("map_batches", fn, batch_format=batch_format,
-                           batch_size=batch_size)
+                    batch_size: Optional[int] = None,
+                    compute: Optional[str] = None,
+                    concurrency=None,
+                    fn_constructor_args: Optional[tuple] = None,
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    ray_remote_args: Optional[dict] = None,
+                    max_tasks_in_flight_per_actor: int = 2) -> "Dataset":
+        """Apply fn per block. With a CLASS fn (or compute="actors"), the
+        stage runs on a pool of stateful actors: the class constructs once
+        per actor (model loads happen there), blocks route to the
+        least-loaded actor, and the pool scales within `concurrency`
+        (int = fixed size, (min, max) = autoscaling) — reference:
+        ActorPoolMapOperator + Dataset.map_batches(concurrency=...).
+        Execution is at-least-once (as in the reference): a block may be
+        re-applied after a worker failure or connection drop, so UDFs must
+        be idempotent per block — pure transforms are; UDFs accumulating
+        cross-block state should key their state by block content.
+        With tasks-compute, an int concurrency caps the stage's concurrent
+        tasks and ray_remote_args (resources/labels) pin the tasks.
+        batch_size is advisory: blocks are the batching unit (the reference
+        re-batches too; we keep block==batch for zero re-slicing)."""
+        if isinstance(fn, type) and compute is None:
+            compute = "actors"
+        if compute not in (None, "tasks", "actors"):
+            raise ValueError(f"compute must be 'tasks'|'actors', got {compute!r}")
+        if compute != "actors":
+            if isinstance(fn, type):
+                raise ValueError(
+                    "a class UDF is stateful and must run on the actor pool; "
+                    "drop compute='tasks' (class fns imply compute='actors')"
+                )
+            if fn_constructor_args or fn_constructor_kwargs:
+                raise ValueError("fn_constructor_* requires a class fn / compute='actors'")
+            return self._chain(
+                "map_batches", fn, batch_format=batch_format,
+                batch_size=batch_size, concurrency=concurrency,
+                ray_remote_args=ray_remote_args,
+            )
+        return self._chain(
+            "map_batches", fn, batch_format=batch_format, batch_size=batch_size,
+            compute="actors", concurrency=concurrency,
+            fn_constructor_args=fn_constructor_args,
+            fn_constructor_kwargs=fn_constructor_kwargs,
+            ray_remote_args=ray_remote_args,
+            max_tasks_in_flight_per_actor=max_tasks_in_flight_per_actor,
+        )
 
     def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
         return self._chain("filter", fn)

@@ -58,6 +58,32 @@ def _read_fn_task(read_fn: Callable):
     return read_fn()
 
 
+def _wait_done(rt, refs: list):
+    """Block until every ref is terminal (done OR failed). A bare rt.wait
+    timeout is indistinguishable from completion — treating it as done and
+    then killing the pool would turn a merely-slow task into ActorDiedError
+    for a ref already handed to the consumer."""
+    remaining = list(refs)
+    while remaining:
+        done, remaining = rt.wait(remaining, num_returns=len(remaining), timeout=60)
+        remaining = list(remaining)
+
+
+class _MapWorker:
+    """Actor-pool map_batches executor (reference: _MapWorker inside
+    ActorPoolMapOperator, actor_pool_map_operator.py:546): constructs the UDF
+    ONCE (class UDFs pay their model load here, not per block), then applies
+    it to streamed blocks."""
+
+    def __init__(self, fn, ctor_args, ctor_kwargs, batch_format):
+        self.batch_format = batch_format
+        self.fn = fn(*ctor_args, **ctor_kwargs) if isinstance(fn, type) else fn
+
+    def apply(self, blk):
+        out = self.fn(B.block_to_batch(blk, self.batch_format))
+        return B.block_from_batch(out)
+
+
 class StreamingExecutor:
     def __init__(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
                  budgets: Optional[dict] = None):
@@ -85,6 +111,13 @@ class StreamingExecutor:
         stream = self._source_stream(src)
         seg: list[LogicalOp] = []
         for op in rest:
+            if op.kind == "map_batches" and op.params.get("compute") == "actors":
+                # Stateful stage: runs on an actor pool (its own boundary —
+                # it cannot fuse into stateless task segments).
+                stream = self._mapped_stream(stream, seg)
+                seg = []
+                stream = self._actor_pool_stream(stream, op)
+                continue
             if op.is_one_to_one:
                 seg.append(op)
                 continue
@@ -131,13 +164,89 @@ class StreamingExecutor:
 
         ops = [(o.kind, o.fn, o.params) for o in seg]
         task = rt.remote(_apply_segment)
+        remote_args = {}
+        for o in seg:  # per-op ray_remote_args (resources etc) apply to the fused task
+            remote_args.update(o.params.get("ray_remote_args") or {})
+        if remote_args:
+            task = task.options(**remote_args)
         budget = self._budget([o.kind for o in seg])
+        # map_batches(concurrency=N) with tasks-compute caps THIS stage.
+        caps = [int(o.params["concurrency"]) for o in seg
+                if isinstance(o.params.get("concurrency"), int)]
+        if caps:
+            budget = min(budget, *caps)
         pending: list = []
         for ref in stream:
             pending.append(task.remote(ref, ops))
             while len(pending) >= budget:
                 yield pending.pop(0)
         yield from pending
+
+    # -- actor-pool stage --------------------------------------------------
+    def _actor_pool_stream(self, stream: Iterator, op: LogicalOp) -> Iterator:
+        """Stateful map_batches on a pool of long-lived actors (reference:
+        ActorPoolMapOperator, _internal/execution/operators/
+        actor_pool_map_operator.py:70 — how model-inference UDFs run: the
+        class constructs ONCE per actor, blocks route to the least-loaded
+        actor with bounded in-flight backpressure, the pool scales between
+        min and max on backlog). Pool actors restart on failure and their
+        in-flight calls retry on the replacement (max_restarts +
+        max_task_retries — the core's actor FSM), so one dying actor costs
+        retries, not the dataset."""
+        import ray_tpu as rt
+
+        conc = op.params.get("concurrency") or 1
+        mn, mx = (conc if isinstance(conc, (tuple, list)) else (conc, conc))
+        mn, mx = max(1, int(mn)), max(1, int(mx))
+        per_actor = int(op.params.get("max_tasks_in_flight_per_actor", 2))
+        actor_cls = rt.remote(_MapWorker)
+        opts = dict(op.params.get("ray_remote_args") or {})
+        opts.setdefault("max_restarts", -1)
+        opts.setdefault("max_task_retries", 3)
+        ctor = (
+            op.fn,
+            op.params.get("fn_constructor_args") or (),
+            op.params.get("fn_constructor_kwargs") or {},
+            op.params.get("batch_format", "numpy"),
+        )
+
+        def spawn():
+            return actor_cls.options(**opts).remote(*ctor)
+
+        actors = [spawn() for _ in range(mn)]
+        loads = [0] * len(actors)
+        pending: list = []  # (out_ref, actor_idx), submission order
+        completed = False
+        try:
+            for ref in stream:
+                while pending and len(pending) >= len(actors) * per_actor:
+                    if len(actors) < mx:
+                        # Saturated below the ceiling: scale the pool up.
+                        actors.append(spawn())
+                        loads.append(0)
+                        break
+                    out, idx = pending.pop(0)
+                    _wait_done(rt, [out])
+                    loads[idx] -= 1
+                    yield out
+                idx = loads.index(min(loads))
+                pending.append((actors[idx].apply.remote(ref), idx))
+                loads[idx] += 1
+            for out, _idx in pending:
+                yield out
+            completed = True
+        finally:
+            if completed and pending:
+                # Tail refs were yielded before their tasks finished: let
+                # them land in the object store before the pool dies.
+                _wait_done(rt, [o for o, _ in pending])
+            # Normal end OR consumer closed early: the pool is stage-owned,
+            # tear it down (early close also abandons unfinished work).
+            for a in actors:
+                try:
+                    rt.kill(a, no_restart=True)
+                except Exception:
+                    pass
 
     # -- all-to-all stages -------------------------------------------------
     def _all_to_all(self, stream: Iterator, op: LogicalOp) -> Iterator:

@@ -9,6 +9,7 @@ all slots — continuous batching is the host loop admitting/retiring slots
 between steps.
 """
 from ray_tpu.llm.engine import EngineConfig, LLMEngine
+from ray_tpu.llm.batch import batch_generate
 from ray_tpu.llm.deployment import LLMServer, build_llm_app
 from ray_tpu.llm.openai import OpenAIServer, build_openai_app
 from ray_tpu.llm.sampling import SamplingParams
@@ -17,5 +18,5 @@ from ray_tpu.llm.tokenizer import HFTokenizer, Tokenizer, load_tokenizer
 __all__ = [
     "EngineConfig", "LLMEngine", "LLMServer", "build_llm_app",
     "OpenAIServer", "build_openai_app", "SamplingParams",
-    "Tokenizer", "HFTokenizer", "load_tokenizer",
+    "Tokenizer", "HFTokenizer", "load_tokenizer", "batch_generate",
 ]

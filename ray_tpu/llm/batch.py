@@ -1,0 +1,112 @@
+"""LLM batch inference over Datasets: the engine as a stateful Data stage.
+
+Role-equivalent to the reference's vLLMEngineStage
+(/root/reference/python/ray/llm/_internal/batch/stages/vllm_engine_stage.py:794 — the
+engine runs inside actor-pool UDFs so model load happens once per actor and
+blocks of prompts stream through). Here the stage is an actor-pool
+map_batches whose class UDF owns one LLMEngine + tokenizer: every block of
+prompts is admitted to the engine's continuous-batching loop TOGETHER (the
+whole block shares prefill groups and fused decode blocks — the engine's
+throughput path, not row-at-a-time generate).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class _EngineUDF:
+    """Constructed once per pool actor: loads the engine, then maps blocks
+    of prompts to completions."""
+
+    def __init__(self, model_config: dict, engine_config: Optional[dict],
+                 sampling: Optional[dict], tokenizer_spec: Optional[str],
+                 input_column: str, output_column: str):
+        from ray_tpu.llm.engine import EngineConfig, LLMEngine
+        from ray_tpu.llm.sampling import SamplingParams
+        from ray_tpu.llm.tokenizer import load_tokenizer
+        from ray_tpu.models.transformer import TransformerConfig
+
+        self.tok = load_tokenizer(tokenizer_spec)
+        ec = dict(engine_config or {})
+        if "eos_id" not in ec and self.tok.eos_id >= 0:
+            ec["eos_id"] = self.tok.eos_id
+        self.engine = LLMEngine(
+            TransformerConfig(**model_config), engine_config=EngineConfig(**ec)
+        )
+        self.sampling = SamplingParams(**(sampling or {}))
+        self.input_column = input_column
+        self.output_column = output_column
+
+    def __call__(self, rows: list) -> list:
+        import uuid
+
+        # Pre-encode + validate EVERY row before admitting any: a mid-block
+        # ValueError (e.g. over-long prompt) must not leave half a block
+        # orphaned in the persistent per-actor engine.
+        encoded = []
+        for row in rows:
+            value = row[self.input_column]
+            tokens = (
+                self.tok.encode(value, add_bos=True)
+                if isinstance(value, str) else list(map(int, value))
+            )
+            if len(tokens) >= self.engine.ec.max_seq:
+                raise ValueError(
+                    f"prompt of {len(tokens)} tokens >= engine max_seq "
+                    f"{self.engine.ec.max_seq} (row: {str(value)[:80]!r})"
+                )
+            encoded.append(tokens)
+        # Unique ids per apply() call: a retried/duplicated execution (task
+        # retry after a connection drop) must never collide with a previous
+        # admission of the same block; foreign finished events (orphans of a
+        # lost call) are drained and discarded by the `in ids` guard.
+        prefix = uuid.uuid4().hex[:8]
+        ids = {}
+        for i, tokens in enumerate(encoded):
+            rid = f"{prefix}-{i}"
+            ids[rid] = i
+            self.engine.add_request(rid, tokens, sampling=self.sampling)
+        done: dict[int, list] = {}
+        while self.engine.has_work():
+            for rid, ev in self.engine.step().items():
+                if ev.get("finished") and rid in ids:
+                    done[ids[rid]] = ev["tokens"]
+        out = []
+        for i, row in enumerate(rows):
+            row = dict(row)
+            toks = done[i]
+            row[self.output_column] = self.tok.decode(toks)
+            row[self.output_column + "_tokens"] = list(map(int, toks))
+            out.append(row)
+        return out
+
+
+def batch_generate(ds, model_config: dict, engine_config: Optional[dict] = None,
+                   sampling: Optional[dict] = None, *,
+                   concurrency=1,
+                   tokenizer: Optional[str] = None,
+                   input_column: str = "prompt",
+                   output_column: str = "generated_text",
+                   ray_remote_args: Optional[dict] = None):
+    """Map a Dataset of prompts through an actor-pool of TPU engines.
+
+    ds rows carry `input_column` (text, or a token-id list); the result adds
+    `output_column` (text) and `output_column + "_tokens"`. concurrency:
+    int or (min, max) pool size — each pool actor loads the model ONCE
+    (pass ray_remote_args={"resources": {"TPU": n}} to pin actors to chips).
+    Lazy like every Data op: executes when the dataset is consumed.
+    """
+    return ds.map_batches(
+        _EngineUDF,
+        compute="actors",
+        concurrency=concurrency,
+        batch_format="rows",
+        fn_constructor_args=(
+            model_config, engine_config, sampling, tokenizer,
+            input_column, output_column,
+        ),
+        ray_remote_args=ray_remote_args,
+        # An engine consumes a whole block per call; queueing more than one
+        # extra block per actor just pins memory.
+        max_tasks_in_flight_per_actor=2,
+    )
