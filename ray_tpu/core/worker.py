@@ -1656,7 +1656,9 @@ class CoreWorker:
         except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
             # OSError covers raw transport errors (ConnectionResetError from
             # writer.drain()) that the rpc layer does not wrap.
-            failed_addr = entry.get("addr") or ""
+            failed_addr = entry.get("addr") or entry.get("last_failed", "")
+            if entry.get("addr"):
+                entry["last_failed"] = entry["addr"]
             entry["conn"] = None
             entry["addr"] = ""
             for fut in [f for _, f in sent]:
@@ -1725,7 +1727,12 @@ class CoreWorker:
             # Connection dropped mid-flight: the task may or may not have
             # executed. Resend ONLY if the user opted into retries
             # (max_task_retries > 0) — otherwise at-most-once wins.
-            bad_addr = entry.get("addr") or ""
+            # Concurrent failure handlers race on the shared entry: whoever
+            # clears addr first records it in last_failed so later handlers
+            # still guard against the stale address.
+            bad_addr = entry.get("addr") or entry.get("last_failed", "")
+            if entry.get("addr"):
+                entry["last_failed"] = entry["addr"]
             entry["conn"] = None
             entry["addr"] = ""
             if getattr(spec.options, "max_task_retries", 0) > 0:
@@ -1772,6 +1779,8 @@ class CoreWorker:
             # out of writer.drain) — anything escaping here would kill the
             # retry task and leave the caller's ref unresolved forever.
             failed = entry.get("addr") or bad_addr
+            if entry.get("addr"):
+                entry["last_failed"] = entry["addr"]
             entry["conn"] = None
             entry["addr"] = ""
             max_task_retries = getattr(spec.options, "max_task_retries", 0)

@@ -77,6 +77,11 @@ class Dataset:
                 )
             if fn_constructor_args or fn_constructor_kwargs:
                 raise ValueError("fn_constructor_* requires a class fn / compute='actors'")
+            if concurrency is not None and not isinstance(concurrency, int):
+                raise ValueError(
+                    "tuple concurrency (min, max) is an actor-pool size; with "
+                    "tasks-compute pass an int task cap"
+                )
             return self._chain(
                 "map_batches", fn, batch_format=batch_format,
                 batch_size=batch_size, concurrency=concurrency,

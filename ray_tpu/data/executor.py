@@ -281,23 +281,22 @@ class StreamingExecutor:
         else:
             raise ValueError(f"unknown all-to-all op {op.kind}")
 
-    def _hash_shuffle(self, refs: list, key: str, n_parts: int) -> list[list]:
-        """Map-side hash partition: one task per input block emits n_parts
-        sub-blocks as SEPARATE return objects (reference:
-        _internal/execution/operators/hash_shuffle.py — map tasks partition,
-        reduce tasks consume their column of the partition matrix). Returns
-        parts[p] = list of sub-block refs for partition p; data flows block
-        -> partition pieces -> reduce through the object store, never the
-        driver."""
+    def _partition_shuffle(self, refs: list, part_fn, part_args: tuple,
+                           n_parts: int, budget_kind: str) -> list[list]:
+        """Map side of any shuffle: one multi-return task per input block
+        emits n_parts sub-blocks as SEPARATE objects (reference:
+        hash_shuffle.py / sort_task_spec.py map tasks). Returns parts[p] =
+        sub-block refs for partition p; data flows block -> pieces -> reduce
+        through the object store, never the driver. Bounded in-flight
+        partition tasks = backpressure."""
         import ray_tpu as rt
 
-        n_parts = max(1, n_parts)
-        budget = self._budget(["hash_partition"])
-        part_task = rt.remote(_hash_partition).options(num_returns=n_parts)
+        budget = self._budget([budget_kind])
+        part_task = rt.remote(part_fn).options(num_returns=n_parts)
         parts: list[list] = [[] for _ in range(n_parts)]
         in_flight: list = []
         for ref in refs:
-            out = part_task.remote(key, n_parts, ref)
+            out = part_task.remote(*part_args, ref)
             out = [out] if n_parts == 1 else out
             for p, r in enumerate(out):
                 parts[p].append(r)
@@ -306,6 +305,12 @@ class StreamingExecutor:
                 rt.wait(in_flight, num_returns=1, timeout=300)
                 in_flight = in_flight[1:]
         return parts
+
+    def _hash_shuffle(self, refs: list, key: str, n_parts: int) -> list[list]:
+        n_parts = max(1, n_parts)
+        return self._partition_shuffle(
+            refs, _hash_partition, (key, n_parts), n_parts, "hash_partition"
+        )
 
     def _join(self, stream: Iterator, op: LogicalOp) -> Iterator:
         """Hash join (reference: _internal/execution/operators/join.py):
@@ -369,10 +374,34 @@ class StreamingExecutor:
                 yield build.remote(idxs, counts, *refs)
 
     def _sort(self, refs: list, key: str, descending: bool) -> Iterator:
+        """Distributed sample-sort (reference: SortTaskSpec,
+        _internal/planner/exchange/sort_task_spec.py:94,164 — sample key
+        ranges, range-partition every block, per-range sort-merge). No task
+        ever materializes more than one partition: samples flow to the
+        driver (tiny), data flows block -> range pieces -> merge through the
+        object store. Output refs stream in global key order."""
         import ray_tpu as rt
 
-        merged = rt.remote(_sort_all).remote(key, descending, *refs)
-        yield merged
+        n_parts = min(8, len(refs))
+        if n_parts <= 1:
+            yield rt.remote(_sort_merge_part).remote(key, descending, *refs)
+            return
+        sample_task = rt.remote(_sample_keys)
+        samples = rt.get([sample_task.remote(key, r) for r in refs])
+        flat = sorted(v for s in samples for v in s)
+        if not flat:
+            yield rt.remote(_sort_merge_part).remote(key, descending, *refs)
+            return
+        # n_parts-1 boundary values at sample quantiles (reference:
+        # SortTaskSpec.sample_boundaries).
+        bounds = [flat[(len(flat) * i) // n_parts] for i in range(1, n_parts)]
+        parts = self._partition_shuffle(
+            refs, _range_partition, (key, bounds), n_parts, "sort"
+        )
+        merge = rt.remote(_sort_merge_part)
+        order = range(n_parts - 1, -1, -1) if descending else range(n_parts)
+        for p in order:
+            yield merge.remote(key, descending, *parts[p])
 
     def _groupby(self, refs: list, key: str, agg_fn: Callable) -> Iterator:
         import ray_tpu as rt
@@ -452,7 +481,32 @@ def _zip_all(n_left: int, *blocks):
     return out
 
 
-def _sort_all(key: str, descending: bool, *blocks):
+def _sample_keys(key: str, blk, max_samples: int = 64):
+    """Evenly-strided key sample of one block (sort boundary estimation)."""
+    n = blk.num_rows
+    if n == 0:
+        return []
+    vals = blk.column(key).to_pylist()
+    stride = max(1, n // max_samples)
+    return vals[::stride][:max_samples]
+
+
+def _range_partition(key: str, bounds: list, blk):
+    """Split one block into len(bounds)+1 range pieces (multi-return task):
+    piece p holds rows with bounds[p-1] <= key < bounds[p]."""
+    import bisect
+
+    n_parts = len(bounds) + 1
+    if blk.num_rows == 0:
+        return tuple([blk] * n_parts)
+    vals = blk.column(key).to_pylist()
+    ids = np.fromiter((bisect.bisect_right(bounds, v) for v in vals), np.int64, len(vals))
+    return tuple(B.block_take(blk, np.nonzero(ids == p)[0]) for p in range(n_parts))
+
+
+def _sort_merge_part(key: str, descending: bool, *blocks):
+    """Sort one range partition (every row of the range is here, so the
+    per-partition sort is globally correct in partition order)."""
     merged = B.concat_blocks(list(blocks))
     if merged.num_rows == 0:
         return merged

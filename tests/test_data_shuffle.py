@@ -166,3 +166,37 @@ def test_join_across_numeric_dtypes():
     out = left.join(right, on="k").take_all()
     assert len(out) == 12, f"cross-dtype join dropped rows: {len(out)}"
     assert all(r["b"] == r["a"] * 2 for r in out)
+
+
+def test_distributed_sort_multiblock_global_order():
+    """Sample-sort (reference: SortTaskSpec sample->boundaries->partition->
+    merge): many input blocks, output streams in GLOBAL key order as
+    multiple range partitions — no task ever saw the whole dataset."""
+    rng = np.random.default_rng(7)
+    vals = rng.permutation(200).tolist()
+    ds = rd.from_items([{"v": int(v), "tag": f"t{v}"} for v in vals], parallelism=10)
+    out_blocks = [rt.get(r) for r in ds.sort("v").iter_block_refs()]
+    # Multiple range partitions, each a separate merge task's output.
+    nonempty = [b for b in out_blocks if b.num_rows]
+    assert len(nonempty) > 1, "sort collapsed to a single task"
+    assert max(b.num_rows for b in nonempty) < 200, \
+        "one sort task materialized the whole dataset"
+    rows = [r for b in nonempty for r in b.to_pylist()]
+    assert [r["v"] for r in rows] == sorted(vals)
+    assert all(r["tag"] == f"t{r['v']}" for r in rows)  # rows stay intact
+
+
+def test_distributed_sort_descending_and_strings():
+    words = ["pear", "apple", "fig", "kiwi", "lime", "date", "plum", "mango"] * 5
+    ds = rd.from_items([{"w": w, "i": i} for i, w in enumerate(words)], parallelism=8)
+    got = [r["w"] for r in ds.sort("w", descending=True).take_all()]
+    assert got == sorted(words, reverse=True)
+
+
+def test_distributed_sort_skewed_keys():
+    """Heavy key skew (duplicate boundaries) must not lose or duplicate
+    rows."""
+    vals = [1] * 50 + [2] * 3 + [99] * 20
+    ds = rd.from_items([{"v": v} for v in vals], parallelism=8)
+    got = [r["v"] for r in ds.sort("v").take_all()]
+    assert got == sorted(vals)
