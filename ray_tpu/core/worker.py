@@ -1617,10 +1617,7 @@ class CoreWorker:
             entry = self._actor_conns[actor_id] = {"addr": "", "conn": None}
         sent: list[tuple[TaskSpec, asyncio.Future]] = []
         try:
-            if entry["conn"] is None or entry["conn"].closed:
-                if not entry["addr"]:
-                    await self._refresh_actor_addr(actor_id, entry)
-                entry["conn"] = await self._peer_conn(entry["addr"])
+            await self._actor_conn_fresh(specs[0], entry)
             interned = entry["conn"].meta.setdefault("opts_out", {})
             for spec in specs:
                 if spec.num_returns == -1:
@@ -1656,9 +1653,6 @@ class CoreWorker:
         except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
             # OSError covers raw transport errors (ConnectionResetError from
             # writer.drain()) that the rpc layer does not wrap.
-            failed_addr = entry.get("addr") or entry.get("last_failed", "")
-            if entry.get("addr"):
-                entry["last_failed"] = entry["addr"]
             entry["conn"] = None
             entry["addr"] = ""
             for fut in [f for _, f in sent]:
@@ -1678,7 +1672,7 @@ class CoreWorker:
             for spec in specs:
                 if getattr(spec.options, "max_task_retries", 0) > 0:
                     try:
-                        await self._push_actor_task(spec, attempt=1, bad_addr=failed_addr)
+                        await self._push_actor_task(spec, attempt=1)
                     except ActorDiedError as e2:
                         self._fail_task_returns(spec, e2)
                 else:
@@ -1727,16 +1721,10 @@ class CoreWorker:
             # Connection dropped mid-flight: the task may or may not have
             # executed. Resend ONLY if the user opted into retries
             # (max_task_retries > 0) — otherwise at-most-once wins.
-            # Concurrent failure handlers race on the shared entry: whoever
-            # clears addr first records it in last_failed so later handlers
-            # still guard against the stale address.
-            bad_addr = entry.get("addr") or entry.get("last_failed", "")
-            if entry.get("addr"):
-                entry["last_failed"] = entry["addr"]
             entry["conn"] = None
             entry["addr"] = ""
             if getattr(spec.options, "max_task_retries", 0) > 0:
-                await self._push_actor_task(spec, attempt=1, bad_addr=bad_addr)
+                await self._push_actor_task(spec, attempt=1)
             else:
                 self._fail_task_returns(
                     spec,
@@ -1747,29 +1735,41 @@ class CoreWorker:
         else:
             self._absorb_task_reply(spec, reply)
 
-    async def _push_actor_task(self, spec: TaskSpec, attempt: int = 0, bad_addr: str = ""):
+    async def _actor_conn_fresh(self, spec: TaskSpec, entry: dict) -> None:
+        """Ensure entry has a LIVE connection to the actor's current worker.
+
+        Evidence-based stale-address handling: refresh from the controller
+        and DIAL the address it reports. Only when that dial fails (the
+        worker is really gone) poll for the record to move — RESTARTING
+        blocks inside wait_actor_alive, a restarted incarnation gets a NEW
+        worker address, DEAD raises ActorDiedError. A transient connection
+        reset to a healthy actor therefore redials the same address and
+        proceeds immediately (no false death)."""
+        if entry["conn"] is not None and not entry["conn"].closed:
+            return
+        if not entry["addr"]:
+            await self._refresh_actor_addr(spec.actor_id, entry)
+        try:
+            entry["conn"] = await self._peer_conn(entry["addr"])
+            return
+        except (rpc.ConnectionLost, OSError):
+            dead = entry["addr"]
+        deadline = time.monotonic() + self.config.actor_creation_timeout_s
+        while entry["addr"] == dead:
+            if time.monotonic() > deadline:
+                raise ActorDiedError(
+                    f"actor {spec.actor_id.hex()[:8]} never left dead address {dead}"
+                )
+            await asyncio.sleep(self.config.task_retry_delay_s)
+            await self._refresh_actor_addr(spec.actor_id, entry)
+        entry["conn"] = await self._peer_conn(entry["addr"])
+
+    async def _push_actor_task(self, spec: TaskSpec, attempt: int = 0):
         entry = self._actor_conns.get(spec.actor_id)
         if entry is None:
             entry = self._actor_conns[spec.actor_id] = {"addr": "", "conn": None}
         try:
-            if entry["conn"] is None or entry["conn"].closed:
-                if not entry["addr"] or entry["addr"] == bad_addr:
-                    await self._refresh_actor_addr(spec.actor_id, entry)
-                    # Stale-address window: the controller may not have seen
-                    # the death yet and hands back the address that just
-                    # failed. Poll until the record moves — RESTARTING blocks
-                    # inside wait_actor_alive, a restarted incarnation gets a
-                    # NEW worker address, DEAD raises ActorDiedError.
-                    deadline = time.monotonic() + self.config.actor_creation_timeout_s
-                    while bad_addr and entry["addr"] == bad_addr:
-                        if time.monotonic() > deadline:
-                            raise ActorDiedError(
-                                f"actor {spec.actor_id.hex()[:8]} never left failed "
-                                f"address {bad_addr}"
-                            )
-                        await asyncio.sleep(self.config.task_retry_delay_s)
-                        await self._refresh_actor_addr(spec.actor_id, entry)
-                entry["conn"] = await self._peer_conn(entry["addr"])
+            await self._actor_conn_fresh(spec, entry)
             reply = await entry["conn"].call("push_actor_task", {"spec": spec})
             self._absorb_task_reply(spec, reply)
         except ActorDiedError as e:
@@ -1778,15 +1778,12 @@ class CoreWorker:
             # OSError covers raw transport failures (ConnectionReset/BrokenPipe
             # out of writer.drain) — anything escaping here would kill the
             # retry task and leave the caller's ref unresolved forever.
-            failed = entry.get("addr") or bad_addr
-            if entry.get("addr"):
-                entry["last_failed"] = entry["addr"]
             entry["conn"] = None
             entry["addr"] = ""
             max_task_retries = getattr(spec.options, "max_task_retries", 0)
             if attempt < max_task_retries:
                 await asyncio.sleep(self.config.task_retry_delay_s)
-                await self._push_actor_task(spec, attempt + 1, bad_addr=failed)
+                await self._push_actor_task(spec, attempt + 1)
             else:
                 self._fail_task_returns(
                     spec, ActorDiedError(f"actor {spec.actor_id.hex()[:8]} task {spec.method_name} failed: {e}")

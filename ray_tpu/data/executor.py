@@ -387,7 +387,17 @@ class StreamingExecutor:
             yield rt.remote(_sort_merge_part).remote(key, descending, *refs)
             return
         sample_task = rt.remote(_sample_keys)
-        samples = rt.get([sample_task.remote(key, r) for r in refs])
+        budget = self._budget(["sort"])
+        sample_refs: list = []
+        in_flight: list = []
+        for ref in refs:  # bounded in-flight, same backpressure as the shuffles
+            r = sample_task.remote(key, ref)
+            sample_refs.append(r)
+            in_flight.append(r)
+            if len(in_flight) >= budget:
+                rt.wait(in_flight, num_returns=1, timeout=300)
+                in_flight = in_flight[1:]
+        samples = rt.get(sample_refs)
         flat = sorted(v for s in samples for v in s)
         if not flat:
             yield rt.remote(_sort_merge_part).remote(key, descending, *refs)

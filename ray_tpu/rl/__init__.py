@@ -14,6 +14,7 @@ Role-equivalent to the reference's RLlib core split (rllib/):
     importance weights.
 """
 from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.replay_buffer import (
     PrioritizedReplayBuffer,
@@ -24,6 +25,8 @@ from ray_tpu.rl.replay_buffer import (
 __all__ = [
     "DQN",
     "DQNConfig",
+    "IMPALA",
+    "IMPALAConfig",
     "PPO",
     "PPOConfig",
     "PrioritizedReplayBuffer",

@@ -71,6 +71,7 @@ class EnvRunner:
             self._prev_done = done
         _, last_values = np_logits_values(self.params, self.obs)
         return {
+            "last_obs": self.obs.copy(),  # bootstrap obs (IMPALA recomputes V under current params)
             "obs": obs_buf,
             "actions": act_buf,
             "logp": logp_buf,
