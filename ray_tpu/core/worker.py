@@ -769,9 +769,16 @@ class CoreWorker:
         oid = ref.id
         data = self.memory_store.get(oid)
         if data is None:
-            rec = self.owned.get(oid)
-            if rec is not None and ref.owner_addr == self.address and rec.state != "READY":
-                return _MISS  # pending or failed: the slow path handles both
+            if ref.owner_addr == self.address:
+                # Owner-local: the record is authoritative. PENDING, FAILED,
+                # or registration still queued on the IO loop (rec None —
+                # submit_actor_task_sync registers via call_soon_threadsafe,
+                # and the caller's get usually beats it) must NOT probe the
+                # shm arena: a futile get_pinned + spill-restore stat per
+                # call was the sync-call hot path's biggest syscall cost.
+                rec = self.owned.get(oid)
+                if rec is None or rec.state != "READY":
+                    return _MISS  # the slow path waits/raises as appropriate
             if self.store is None:
                 return _MISS
             data = self._read_shm(oid)
@@ -1710,11 +1717,23 @@ class CoreWorker:
                 )
             return
         for spec, fut in sent:
-            asyncio.create_task(self._await_actor_reply(spec, fut, entry))
+            fut.add_done_callback(
+                functools.partial(self._on_actor_reply, spec, entry=entry)
+            )
 
-    async def _await_actor_reply(self, spec: TaskSpec, fut, entry):
+    def _on_actor_reply(self, spec: TaskSpec, fut, entry):
+        """Reply-future done callback (hot path: NO task per call — absorb
+        runs synchronously in the callback; only the exceptional paths spawn
+        a coroutine)."""
+        exc = fut.cancelled() or fut.exception()
+        if not exc:
+            self._absorb_task_reply(spec, fut.result())
+            return
+        asyncio.ensure_future(self._actor_reply_failed(spec, fut, entry))
+
+    async def _actor_reply_failed(self, spec: TaskSpec, fut, entry):
         try:
-            reply = await fut
+            await fut
         except ActorDiedError as e:
             self._fail_task_returns(spec, e)
         except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
@@ -1732,8 +1751,6 @@ class CoreWorker:
                         f"actor {spec.actor_id.hex()[:8]} task {spec.method_name} lost in flight: {e}"
                     ),
                 )
-        else:
-            self._absorb_task_reply(spec, reply)
 
     async def _actor_conn_fresh(self, spec: TaskSpec, entry: dict) -> None:
         """Ensure entry has a LIVE connection to the actor's current worker.
