@@ -46,7 +46,20 @@ class TransformerConfig:
     n_experts: int = 0
     expert_top_k: int = 2
     remat: bool = False
-    attention_impl: str = "auto"  # auto | flash | reference | ring
+    # Rematerialization policy when remat=True: "full" recomputes the whole
+    # layer in bwd; "dots" (jax dots_with_no_batch_dims_saveable) lets XLA
+    # keep cheap-to-store dot results — measured +1pt MFU on v5e at the
+    # flagship size (PROFILES.md round 4).
+    remat_policy: str = "full"
+    attention_impl: str = "auto"  # auto | flash | splash | reference | ring
+    # Flash-kernel tile sizes (0 = ops/attention.py defaults). v5e at
+    # S=2048/hd=64 measures fastest at 1024x1024 (PROFILES.md round 4).
+    attention_block_q: int = 0
+    attention_block_k: int = 0
+    # Training-loss chunking: compute CE over sequence chunks of this size
+    # so the full [B, S, V] logits never materialize (0 = off). Requires
+    # chunk | (S-1 of the train batch); big win at large vocab (PROFILES.md).
+    ce_chunk: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -184,9 +197,17 @@ def _attention(q, k, v, cfg: TransformerConfig, positions=None, segment_ids=None
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "reference"
     if impl == "flash":
-        from ray_tpu.ops.attention import flash_attention
+        from ray_tpu.ops.attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_attention
 
-        return flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
+        return flash_attention(
+            q, k, v, causal=True, segment_ids=segment_ids,
+            block_q=cfg.attention_block_q or DEFAULT_BLOCK_Q,
+            block_k=cfg.attention_block_k or DEFAULT_BLOCK_K,
+        )
+    if impl == "splash":
+        from ray_tpu.ops.splash import splash_attention
+
+        return splash_attention(q, k, v, causal=True, segment_ids=segment_ids)
     if impl == "ring":
         from ray_tpu.ops.ring_attention import ring_attention
 
@@ -286,13 +307,10 @@ def _layer(x, lp, cfg: TransformerConfig, positions, segment_ids=None):
     return x, aux
 
 
-def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
-            segment_ids=None, positions=None) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab].
-
-    Packed sequences: pass ``segment_ids`` [B, S] (attention masked within
-    segments) and per-segment-restarting ``positions`` [B, S] for RoPE.
-    """
+def forward_hidden(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+                   segment_ids=None, positions=None):
+    """tokens [B, S] int32 -> (final-norm hidden states [B, S, D], moe_aux).
+    The shared trunk of forward() and the chunked-CE training loss."""
     B, S = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = wlc(x, ("batch", "seq", "embed"))
@@ -301,19 +319,34 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
 
     body = functools.partial(_layer, cfg=cfg, positions=positions, segment_ids=segment_ids)
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "dots":
+            body = jax.checkpoint(
+                body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        else:
+            body = jax.checkpoint(body)
 
     def scan_fn(carry, lp):
         y, aux = body(carry, lp)
         return y, aux
 
     x, auxes = lax.scan(scan_fn, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"])
+    return _rms_norm(x, params["final_norm"]), jnp.sum(auxes)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+            segment_ids=None, positions=None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab].
+
+    Packed sequences: pass ``segment_ids`` [B, S] (attention masked within
+    segments) and per-segment-restarting ``positions`` [B, S] for RoPE.
+    """
+    x, aux = forward_hidden(params, tokens, cfg, segment_ids, positions)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype))
     logits = wlc(logits, ("batch", "seq", "vocab"))
     # Keep logits in activation dtype: at vocab=32k the fp32 copy alone is
     # O(GBs) of HBM; the loss upcasts per-reduction instead.
-    return logits, jnp.sum(auxes)
+    return logits, aux
 
 
 def _ce_from_logits(logits, targets, mask=None):
@@ -327,6 +360,45 @@ def _ce_from_logits(logits, targets, mask=None):
     return jnp.mean(nll)
 
 
+def _ce_chunked(x, lm_head, targets, mask, chunk: int):
+    """Fused-style CE: the [B, S, V] logits are never materialized — a
+    rematted scan computes each sequence chunk's logits [B, c, V], reduces
+    to (sum nll, count), and the bwd recomputes them per chunk. At vocab
+    32k / B16 / S2048 this removes a 2+ GB bf16 logits tensor (plus its bwd
+    twin) from HBM, which is what lets batch 24 fit on one v5e and shaves
+    the fwd/bwd logits traffic (PROFILES.md round 4)."""
+    B, S, D = x.shape
+    n = S // chunk
+    if mask is None:
+        mask = jnp.ones((B, S), jnp.float32)
+    mask = mask.astype(jnp.float32)
+
+    @jax.checkpoint
+    def body(xc, tc, mc):
+        logits = jnp.einsum("bcd,dv->bcv", xc, lm_head)
+        lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        nll = lse - picked.astype(jnp.float32)
+        return jnp.sum(nll * mc), jnp.sum(mc)
+
+    # Unrolled chunk loop (n is small): a lax.scan here measured 6x SLOWER
+    # on v5e (the scanned body pessimizes the [D, V] matmul layout). The
+    # optimization_barrier chains each chunk's input on the previous chunk's
+    # sum — without it XLA overlaps all n matmul islands and every chunk's
+    # logits are live at once (OOM, the exact thing chunking exists to fix).
+    tot = jnp.float32(0.0)
+    cnt = jnp.float32(0.0)
+    for i in range(n):
+        sl = slice(i * chunk, (i + 1) * chunk)
+        x_i = x[:, sl]
+        if i:
+            x_i, tot = lax.optimization_barrier((x_i, tot))
+        s_i, c_i = body(x_i, targets[:, sl], mask[:, sl])
+        tot += s_i
+        cnt += c_i
+    return tot / jnp.maximum(cnt, 1.0)
+
+
 def cross_entropy_loss(params, batch, cfg: TransformerConfig):
     """batch: {"tokens": [B, S+1] int32, optional "mask"/"segment_ids"/
     "positions"} -> scalar mean NLL (+ MoE aux). segment_ids enable packed-
@@ -335,18 +407,28 @@ def cross_entropy_loss(params, batch, cfg: TransformerConfig):
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     segs = batch.get("segment_ids")
     pos = batch.get("positions")
-    logits, aux = forward(
-        params, inputs, cfg,
-        segment_ids=None if segs is None else segs[:, :-1],
-        positions=None if pos is None else pos[:, :-1],
-    )
     mask = None if batch.get("mask") is None else batch["mask"][:, 1:].astype(jnp.float32)
     if segs is not None:
         # Don't train the position that predicts across a segment boundary;
         # composes with any provided padding mask.
         boundary = (segs[:, 1:] == segs[:, :-1]).astype(jnp.float32)
         mask = boundary if mask is None else mask * boundary
-    loss = _ce_from_logits(logits, targets, mask)
+    if cfg.ce_chunk and inputs.shape[1] % cfg.ce_chunk == 0:
+        x, aux = forward_hidden(
+            params, inputs, cfg,
+            segment_ids=None if segs is None else segs[:, :-1],
+            positions=None if pos is None else pos[:, :-1],
+        )
+        loss = _ce_chunked(
+            x, params["lm_head"].astype(cfg.dtype), targets, mask, cfg.ce_chunk
+        )
+    else:
+        logits, aux = forward(
+            params, inputs, cfg,
+            segment_ids=None if segs is None else segs[:, :-1],
+            positions=None if pos is None else pos[:, :-1],
+        )
+        loss = _ce_from_logits(logits, targets, mask)
     return loss + 0.01 * aux
 
 

@@ -394,3 +394,49 @@ def test_ulysses_attention_head_indivisible_falls_back_to_ring():
         args = [jax.device_put(x, sh) for x in (q, k, v)]
         out = jax.jit(lambda a, b, c: ulysses_attention(a, b, c))(*args)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_chunked_ce_matches_unchunked():
+    """ce_chunk computes the same loss AND gradients as the materialized
+    path (it exists so [B,S,V] logits never hit HBM — PROFILES.md round 4)."""
+    import dataclasses
+
+    from ray_tpu.models.transformer import cross_entropy_loss
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq_len=64, dtype=jnp.float32, attention_impl="reference",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 33), 0, 128)
+    mask = (jax.random.uniform(jax.random.PRNGKey(2), (3, 33)) > 0.3).astype(jnp.float32)
+    cfgc = dataclasses.replace(cfg, ce_chunk=8)
+    for batch in ({"tokens": tokens}, {"tokens": tokens, "mask": mask}):
+        l0 = float(cross_entropy_loss(params, batch, cfg))
+        l1 = float(cross_entropy_loss(params, batch, cfgc))
+        np.testing.assert_allclose(l0, l1, rtol=1e-5)
+        g0 = jax.grad(lambda p: cross_entropy_loss(p, batch, cfg))(params)
+        g1 = jax.grad(lambda p: cross_entropy_loss(p, batch, cfgc))(params)
+        for k in ("lm_head", "embed"):
+            np.testing.assert_allclose(
+                np.asarray(g0[k]), np.asarray(g1[k]), rtol=2e-4, atol=1e-6
+            )
+
+
+def test_ce_chunk_falls_back_when_not_divisible():
+    """A seq length the chunk doesn't divide silently uses the materialized
+    path (same value) instead of failing."""
+    import dataclasses
+
+    from ray_tpu.models.transformer import cross_entropy_loss
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=1, n_heads=4, d_ff=128,
+        max_seq_len=64, dtype=jnp.float32, attention_impl="reference",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 30), 0, 128)  # S=29, not %8
+    l0 = float(cross_entropy_loss(params, {"tokens": tokens}, cfg))
+    l1 = float(cross_entropy_loss(
+        params, {"tokens": tokens}, dataclasses.replace(cfg, ce_chunk=8)))
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
