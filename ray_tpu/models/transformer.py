@@ -323,8 +323,12 @@ def forward_hidden(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             body = jax.checkpoint(
                 body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
             )
-        else:
+        elif cfg.remat_policy == "full":
             body = jax.checkpoint(body)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} (full|dots)"
+            )
 
     def scan_fn(carry, lp):
         y, aux = body(carry, lp)
@@ -413,6 +417,15 @@ def cross_entropy_loss(params, batch, cfg: TransformerConfig):
         # composes with any provided padding mask.
         boundary = (segs[:, 1:] == segs[:, :-1]).astype(jnp.float32)
         mask = boundary if mask is None else mask * boundary
+    if cfg.ce_chunk and inputs.shape[1] % cfg.ce_chunk:
+        import warnings
+
+        warnings.warn(
+            f"ce_chunk={cfg.ce_chunk} does not divide the train seq length "
+            f"{inputs.shape[1]}; falling back to MATERIALIZED logits "
+            f"([B,S,V] in HBM) — a run sized around chunked CE may OOM here",
+            stacklevel=2,
+        )
     if cfg.ce_chunk and inputs.shape[1] % cfg.ce_chunk == 0:
         x, aux = forward_hidden(
             params, inputs, cfg,

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 
 @functools.lru_cache(maxsize=8)
-def _kernel(S: int, group: int, scale_is_default: bool):
+def _kernel(S: int, group: int):
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk,
         splash_attention_mask as sm,
@@ -46,7 +46,7 @@ def splash_attention(q, k, v, causal: bool = True, scale=None, segment_ids=None)
     # Kernel construction materializes mask arrays; under a jit trace those
     # would become leaked tracers cached in the closure — force eager.
     with jax.ensure_compile_time_eval():
-        kernel = _kernel(S, group, True)
+        kernel = _kernel(S, group)
     # [B,S,H,D] -> [B*KV, group, S, D]; kv -> [B*KV, S, D].
     qt = q.transpose(0, 2, 1, 3).reshape(B, KV, group, S, D).reshape(B * KV, group, S, D)
     qt = (qt.astype(jnp.float32) * scale).astype(q.dtype)
