@@ -340,9 +340,11 @@ class CoreWorker:
         # Generous margin over the dial timeout: on a loaded single-core host
         # (CI running a full cluster per test module) registration RPCs can
         # take several seconds of scheduler delay without anything being wrong.
-        # Margin covers a single-core host where a concurrent XLA compile can
-        # starve this process for tens of seconds (observed in CI-style runs).
-        if not ready.wait(self.config.rpc_connect_timeout_s + 80):
+        # Margin covers a single-core host where a concurrent XLA compile or
+        # the PREVIOUS test cluster's teardown can starve this process for
+        # tens of seconds (observed in full-suite runs; the same init passes
+        # instantly in isolation).
+        if not ready.wait(self.config.rpc_connect_timeout_s + 160):
             raise TimeoutError("driver failed to connect to controller")
 
     async def _async_init(self, ready: threading.Event | None = None):

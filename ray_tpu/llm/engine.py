@@ -85,6 +85,17 @@ class EngineConfig:
     # ceil((prompt + max_tokens + decode_block)/page_size) pages per request
     # and queues when the pool is dry.
     total_pages: int = 0
+    # Prefix KV cache (paged layout only; reference: vLLM automatic prefix
+    # caching + PrefixCacheAffinityRouter, prefix_aware_router.py:39). A
+    # retired request's PROMPT pages stay in an LRU cache keyed by the
+    # prompt's hash; an exact-prompt hit copies them on-device (a few MB
+    # gather vs ~100s of ms of prefill compute) and starts decoding at
+    # position P-1 — the fused decode block re-derives the last position's
+    # KV (identical bytes) and emits the first token with NO prefill.
+    # Partial-prefix (tail-prefill over cached pages) is a documented
+    # follow-up: it needs a chunked-prefill kernel that attends to cached
+    # pages.
+    prefix_cache: bool = False
 
 
 @dataclasses.dataclass
@@ -98,6 +109,8 @@ class _Slot:
     first_token_at: Optional[float] = None
     stop_ids: tuple = ()  # per-request stop tokens (on top of engine eos)
     ignore_eos: bool = False
+    cache_key: Optional[bytes] = None  # cache this prompt's pages at retire
+    prompt_len: int = 0
 
 
 def _attn_proj(h, lp, cfg, dt):
@@ -231,6 +244,41 @@ class LLMEngine:
         self.waiting: deque = deque()
         self._key = jax.random.PRNGKey(self.ec.seed + 1)
         self._prefill_jit: dict[int, Any] = {}
+        # Prefix KV cache: sha1(prompt) -> {"pages": [...], "prompt_len": n},
+        # LRU-ordered; entries own their pages until evicted.
+        from collections import OrderedDict
+
+        self._prefix_cache: "OrderedDict[bytes, dict]" = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        if self.ec.prefix_cache and not self.paged:
+            raise ValueError("prefix_cache requires kv_layout='paged'")
+        if self.paged:
+            ps_ = self.ec.page_size
+            n_pg_axes = (cfg.n_layers, cfg.kv_heads, ps_, cfg.head_dim)
+
+            n_pg = self.ppseq
+
+            def _copy_pages_impl(kp, vp, src, dst):
+                # UNROLLED slice-all-then-update-all (n_pg is small and
+                # static). Formulations that loop (fori_loop carry) or
+                # gather/scatter the page axis made XLA copy the whole
+                # multi-hundred-MB pool per page (~450-570ms measured on
+                # v5e); unrolled, the program runs at this platform's
+                # pool-touching floor (~24ms on the tunneled chip; in-place
+                # on hardware with working buffer donation).
+                ks = [jax.lax.dynamic_slice(kp, (0, 0, src[i] * ps_, 0), n_pg_axes)
+                      for i in range(n_pg)]
+                vs = [jax.lax.dynamic_slice(vp, (0, 0, src[i] * ps_, 0), n_pg_axes)
+                      for i in range(n_pg)]
+                for i in range(n_pg):
+                    kp = jax.lax.dynamic_update_slice(kp, ks[i], (0, 0, dst[i] * ps_, 0))
+                    vp = jax.lax.dynamic_update_slice(vp, vs[i], (0, 0, dst[i] * ps_, 0))
+                return kp, vp
+
+            # Padded rows copy page 0 onto itself (the dead sink) — static
+            # [ppseq] shape, one compiled program for any hit size.
+            self._copy_pages_jit = jax.jit(_copy_pages_impl, donate_argnums=(0, 1))
         if self.paged:
             self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2), static_argnums=(6,))
         else:
@@ -482,6 +530,12 @@ class LLMEngine:
                 )
             self.k_pages, self.v_pages = out[0], out[1]
             jax.device_get(out[2])
+        if self.paged and self.ec.prefix_cache:
+            # Compile the prefix-cache page copy (padded rows hit page 0).
+            z = jnp.zeros(self.ppseq, jnp.int32)
+            self.k_pages, self.v_pages = self._copy_pages_jit(
+                self.k_pages, self.v_pages, z, z
+            )
         # Reset device mirrors dirtied by the dummy executions.
         self.d_lengths = jnp.zeros(self.ec.max_slots, jnp.int32)
         self.d_last = jnp.zeros(self.ec.max_slots, jnp.int32)
@@ -524,13 +578,44 @@ class LLMEngine:
 
     def _retire(self, i: int) -> None:
         """Free slot i's pages and zero its table row (dead slots must write
-        only into page 0 while they keep decoding inside a block)."""
+        only into page 0 while they keep decoding inside a block). With the
+        prefix cache on, an uncached prompt's pages MOVE into the cache
+        instead of the free list."""
         slot = self.slots[i]
         if slot is not None:
-            self.free_pages.extend(slot.pages)
+            n_pp = -(-slot.prompt_len // self.ec.page_size) if self.paged else 0
+            if (
+                slot.cache_key is not None
+                and slot.cache_key not in self._prefix_cache
+                and n_pp > 0
+                and len(slot.pages) >= n_pp
+            ):
+                self._prefix_cache[slot.cache_key] = {
+                    "pages": slot.pages[:n_pp], "prompt_len": slot.prompt_len,
+                }
+                self.free_pages.extend(slot.pages[n_pp:])
+            else:
+                self.free_pages.extend(slot.pages)
         self.slots[i] = None
         self.lengths[i] = 0
         self.page_tables[i, :] = 0
+
+    def _evict_prefix_cache(self, need_pages: int) -> None:
+        """LRU-evict cache entries until need_pages are back in the free
+        list (admission pressure beats cached prefixes)."""
+        while need_pages > 0 and self._prefix_cache:
+            _, entry = self._prefix_cache.popitem(last=False)
+            self.free_pages.extend(entry["pages"])
+            need_pages -= len(entry["pages"])
+
+    @property
+    def prefix_cache_stats(self) -> dict:
+        return {
+            "hits": self.prefix_hits,
+            "misses": self.prefix_misses,
+            "entries": len(self._prefix_cache),
+            "cached_pages": sum(len(e["pages"]) for e in self._prefix_cache.values()),
+        }
 
     def step(self) -> dict:
         """One engine iteration: admit waiting requests into free slots +
@@ -543,30 +628,74 @@ class LLMEngine:
         ps = self.ec.page_size
         # 1. admit: page-budgeted assignment of waiting requests to free slots.
         admitted: list[tuple[int, str, np.ndarray, int, int, float]] = []
+        cache_hits: list[tuple[int, int]] = []  # (slot, last prompt token)
+        use_cache = self.paged and self.ec.prefix_cache
         for i in range(self.ec.max_slots):
             if not self.waiting or self.slots[i] is not None:
                 continue
             req_id, tokens, sp, arrived = self.waiting[0]
             need = self._pages_needed(len(tokens), sp.max_tokens)
             if need > len(self.free_pages):
+                self._evict_prefix_cache(need - len(self.free_pages))
+            if need > len(self.free_pages):
                 break  # head-of-line blocks until pages free (FIFO fairness)
             self.waiting.popleft()
             pages = [self.free_pages.popleft() for _ in range(need)]
             P = len(tokens)
-            bucket = next(b for b in self.buckets if b >= P)
+            key = hit = None
+            if use_cache:
+                import hashlib as _hl
+
+                key = _hl.sha1(np.ascontiguousarray(tokens).tobytes()).digest()
+                hit = self._prefix_cache.get(key)
+                if hit is not None and hit["prompt_len"] != P:
+                    hit = None
             self.slots[i] = _Slot(
                 req_id=req_id, max_tokens=sp.max_tokens, pages=pages,
-                n_generated=1, arrived_at=arrived,
+                n_generated=1 if hit is None else 0, arrived_at=arrived,
                 stop_ids=tuple(sp.stop_token_ids), ignore_eos=sp.ignore_eos,
+                cache_key=key if (use_cache and hit is None) else None,
+                prompt_len=P,
             )
             self.samp_temps[i] = sp.temperature
             self.samp_top_ps[i] = sp.top_p
             self.samp_top_ks[i] = sp.top_k
-            self.lengths[i] = P
             row = np.zeros(self.ppseq, np.int32)
             row[: len(pages)] = pages
             self.page_tables[i] = row
-            admitted.append((i, req_id, tokens, bucket, sp.max_tokens, arrived))
+            if hit is not None:
+                # Exact-prefix hit: copy cached prompt pages, decode from
+                # position P-1 (the block re-derives that position's KV and
+                # emits the first token — no prefill). The copy happens
+                # INLINE, before the next admission can LRU-evict this entry
+                # and recycle its pages (same-step evict-after-claim would
+                # otherwise read pages already back on the free list).
+                self.prefix_hits += 1
+                self._prefix_cache.move_to_end(key)
+                self.lengths[i] = P - 1
+                n_pp = len(hit["pages"])
+                src = np.zeros(self.ppseq, np.int32)
+                src[:n_pp] = hit["pages"]
+                dst = np.zeros(self.ppseq, np.int32)
+                dst[:n_pp] = pages[:n_pp]
+                self.k_pages, self.v_pages = self._copy_pages_jit(
+                    self.k_pages, self.v_pages, jnp.asarray(src), jnp.asarray(dst)
+                )
+                cache_hits.append((i, int(tokens[-1])))
+            else:
+                if use_cache:
+                    self.prefix_misses += 1
+                self.lengths[i] = P
+                bucket = next(b for b in self.buckets if b >= P)
+                admitted.append((i, req_id, tokens, bucket, sp.max_tokens, arrived))
+        if cache_hits:
+            idx = jnp.asarray(np.array([h[0] for h in cache_hits], np.int32))
+            self.d_lengths = self.d_lengths.at[idx].set(
+                jnp.asarray(np.array([self.lengths[h[0]] for h in cache_hits], np.int32))
+            )
+            self.d_last = self.d_last.at[idx].set(
+                jnp.asarray(np.array([h[1] for h in cache_hits], np.int32))
+            )
         # 2. dispatch prefill groups back-to-back (async), fetch in order so
         # each group's TTFT is its own completion time.
         by_bucket: dict[int, list] = {}
@@ -601,7 +730,7 @@ class LLMEngine:
                 self.d_lengths = self.d_lengths.at[idx_arr].set(jnp.asarray(lens))
                 self.d_last = self.d_last.at[idx_arr].set(toks_dev)
                 dispatched.append((chunk, toks_dev))
-        if admitted:
+        if admitted or cache_hits:
             self.d_page_tables = jnp.asarray(self.page_tables)
             self.d_temps = jnp.asarray(self.samp_temps)
             self.d_top_ps = jnp.asarray(self.samp_top_ps)
@@ -633,7 +762,18 @@ class LLMEngine:
             positive = [r for r in remaining if r > 0]
             cap = self.ec.max_seq - 1 - int(max(self.lengths[i] for i in active))
             if positive and cap > 0:
-                block = self.block_sizes[0] if self.waiting else self.block_sizes[-1]
+                # Short block under queue pressure (admissions land sooner)
+                # OR while any slot still owes its FIRST token (prefix-cache
+                # hits skip prefill; their TTFT is the first decode block —
+                # a full block would pay block_size steps of latency for it).
+                awaiting_first = any(
+                    self.slots[i] is not None and not self.slots[i].emitted
+                    for i in active
+                )
+                block = (
+                    self.block_sizes[0] if (self.waiting or awaiting_first)
+                    else self.block_sizes[-1]
+                )
                 # Snap DOWN to a compiled size: an oversized block advances
                 # lengths past max_seq-1 and the clamped device writes would
                 # scribble over the longest slot's earlier KV.
@@ -681,6 +821,11 @@ class LLMEngine:
                     self.lengths[i] += 1
                     slot.emitted.append(tok)
                     ev = events.setdefault(slot.req_id, {"finished": False, "ttft_s": None})
+                    if slot.first_token_at is None:
+                        # Prefix-cache hits skip prefill; their first token
+                        # comes out of the decode block.
+                        slot.first_token_at = time.perf_counter()
+                        ev["ttft_s"] = slot.first_token_at - slot.arrived_at
                     ev["token"] = tok
                     ev.setdefault("new_tokens", []).append(tok)
                     retired |= self._maybe_finish(i, events)
@@ -713,10 +858,11 @@ class LLMEngine:
             self._retire(i)
         return bool(done)
 
-    def generate(self, tokens, max_tokens: int = 64) -> dict:
+    def generate(self, tokens, max_tokens: int = 64,
+                 sampling: SamplingParams | None = None) -> dict:
         """Synchronous single-request convenience: returns {"tokens", "ttft_s"}."""
         req_id = f"g{time.monotonic_ns()}"
-        self.add_request(req_id, tokens, max_tokens)
+        self.add_request(req_id, tokens, max_tokens, sampling=sampling)
         ttft = None
         while True:
             events = self.step()

@@ -261,13 +261,43 @@ class OpenAIServer:
         self._llm.__raytpu_exit__()
 
 
+def openai_prefix_router(request) -> str:
+    """Proxy-side router policy: requests sharing a prompt/messages PREFIX
+    map to one affinity key, so they stick to the replica whose engine holds
+    those KV pages (pair with EngineConfig.prefix_cache=True). Reference:
+    PrefixCacheAffinityRouter, prefix_aware_router.py:39."""
+    import hashlib
+
+    try:
+        body = request.json()
+    except Exception:
+        return ""
+    if not isinstance(body, dict):
+        return ""
+    if "messages" in body:
+        text = "".join(
+            f"{m.get('role', '')}:{m.get('content', '')}\n"
+            for m in body["messages"][:4]
+            if isinstance(m, dict)
+        )
+    else:
+        text = body.get("prompt", "")
+    if not isinstance(text, str) or not text:
+        return ""
+    return hashlib.sha1(text[:256].encode()).hexdigest()[:16]
+
+
 def build_openai_app(model_config: dict, engine_config: Optional[dict] = None,
                      tokenizer: Optional[str] = None, model_name: str = "ray-tpu-llm",
                      num_replicas: int = 1, max_ongoing_requests: Optional[int] = None,
                      warmup_buckets: Optional[tuple] = None,
-                     ray_actor_options: Optional[dict] = None):
+                     ray_actor_options: Optional[dict] = None,
+                     prefix_routing: bool = False):
     """OpenAI-compatible serving app; serve.run(...) it with a route_prefix
-    and POST /v1/chat/completions to the proxy port."""
+    and POST /v1/chat/completions to the proxy port. prefix_routing=True
+    installs the prefix-affinity router policy in the proxy (pair with
+    engine_config={"kv_layout": "paged", "prefix_cache": True} so the sticky
+    replica actually reuses the pages)."""
     from ray_tpu import serve
     from ray_tpu.llm.engine import EngineConfig
 
@@ -278,5 +308,6 @@ def build_openai_app(model_config: dict, engine_config: Optional[dict] = None,
         num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests or slots,
         ray_actor_options=ray_actor_options or {},
+        request_router=openai_prefix_router if prefix_routing else None,
     )
     return dep.bind(model_config, engine_config, tokenizer, model_name, warmup_buckets)

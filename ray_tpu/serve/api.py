@@ -122,6 +122,10 @@ def run(
                         else None
                     ),
                     "ray_actor_options": cfg.ray_actor_options,
+                    "request_router": (
+                        serialization.dumps_function(cfg.request_router)
+                        if cfg.request_router is not None else None
+                    ),
                 },
             }
         )

@@ -10,7 +10,7 @@ replica (autoscaling_state.py:_get_total_num_requests).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,6 +44,13 @@ class DeploymentConfig:
     # DEFAULT_HEALTH_CHECK_TIMEOUT plus its initial-deadline handling).
     startup_timeout_s: float = 600.0
     graceful_shutdown_timeout_s: float = 5.0
+    # Custom request-router policy (reference: pluggable routing policies,
+    # e.g. PrefixCacheAffinityRouter, prefix_aware_router.py:39): a
+    # picklable fn(Request) -> str executed in the PROXY; requests mapping
+    # to the same non-empty key stick to one replica (LRU-bounded, same
+    # machinery as model multiplexing). Clients without a router can pass an
+    # `x-affinity-key` header for the same effect.
+    request_router: Optional[Callable] = None
 
     def initial_replicas(self) -> int:
         if self.autoscaling_config is not None:

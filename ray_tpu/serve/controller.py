@@ -151,6 +151,7 @@ class ServeController:
                 "replica_names": [n for n in dep.replicas],
                 "version": dep.version,
                 "max_ongoing_requests": dep.spec["config"]["max_ongoing_requests"],
+                "request_router": dep.spec["config"].get("request_router"),
             }
 
     def get_route_table(self) -> dict:

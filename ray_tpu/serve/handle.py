@@ -67,9 +67,14 @@ class _ReplicaSet:
         self.version = -1
         self.fetched_at = 0.0
         self.queued = 0
-        # Multiplexing: model id -> replica that last served it (sticky
-        # routing keeps a model's requests on the replica that loaded it).
+        # Sticky affinity: key -> replica that last served it. Keys are
+        # multiplexed model ids (the replica that loaded the model) or
+        # router/affinity keys (prefix routing: the replica whose engine
+        # caches those KV pages).
         self.model_affinity: dict[str, str] = {}
+        # Optional deployment-provided request-router policy fn(Request)->key,
+        # executed by the proxy (reference: PrefixCacheAffinityRouter).
+        self.request_router = None
         self._closed = False
         self._refreshing = False
         self._outstanding: list[tuple[Any, str]] = []  # (ref, replica_name)
@@ -114,6 +119,25 @@ class _ReplicaSet:
                 self.replicas = handles
                 self.version = info["version"]
                 self.max_ongoing = info["max_ongoing_requests"]
+                router_blob = info.get("request_router")
+                if router_blob is not None:
+                    from ray_tpu.core import serialization
+
+                    try:
+                        self.request_router = serialization.loads_function(router_blob)
+                    except Exception:
+                        # Loud fallback: silently reverting to pow-2 would
+                        # make collapsed prefix-cache hit rates undiagnosable.
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "failed to load request_router for %s/%s; "
+                            "falling back to pow-2 routing",
+                            self.app, self.deployment,
+                        )
+                        self.request_router = None
+                else:
+                    self.request_router = None
                 # Drop affinity pins to replicas that left the membership —
                 # stale names are skipped by _pick_locked but would otherwise
                 # sit in the dict forever.
@@ -125,7 +149,7 @@ class _ReplicaSet:
                 self.cond.notify_all()
 
     # -- routing -----------------------------------------------------------
-    def _admit(self, timeout_s: float, model_id: str = ""):
+    def _admit(self, timeout_s: float, model_id: str = "", affinity_key: str = ""):
         """Block until some replica has capacity; returns (name, handle) with
         the ongoing count already incremented."""
         deadline = time.time() + timeout_s
@@ -138,7 +162,7 @@ class _ReplicaSet:
                 except Exception:
                     pass  # transient controller hiccup: retry until deadline
                 with self.cond:
-                    name = self._pick_locked(model_id)
+                    name = self._pick_locked(model_id or affinity_key)
                     if name is not None:
                         self.ongoing[name] = self.ongoing.get(name, 0) + 1
                         return name, self.replicas[name]
@@ -161,10 +185,10 @@ class _ReplicaSet:
             self.cond.notify_all()
 
     def route(self, method: str, args: tuple, kwargs: dict, timeout_s: float = 60.0,
-              model_id: str = ""):
-        """Pick a replica (pow-2 choices; model-affine when a multiplexed
-        model id is set), submit, return (ref, name)."""
-        name, replica = self._admit(timeout_s, model_id=model_id)
+              model_id: str = "", affinity_key: str = ""):
+        """Pick a replica (pow-2 choices; sticky when a multiplexed model id
+        or an affinity key is set), submit, return (ref, name)."""
+        name, replica = self._admit(timeout_s, model_id=model_id, affinity_key=affinity_key)
         try:
             if model_id:
                 ref = replica.handle_request.remote(method, args, kwargs, model_id)
@@ -183,11 +207,11 @@ class _ReplicaSet:
 
     def route_streaming(self, method: str, args: tuple, kwargs: dict,
                         timeout_s: float = 60.0, proxy: bool = False,
-                        model_id: str = ""):
+                        model_id: str = "", affinity_key: str = ""):
         """Streaming variant: returns (ObjectRefGenerator, name). The ongoing
         count is held until the caller exhausts/closes the stream and calls
         _release(name) (DeploymentResponseGenerator owns that)."""
-        name, replica = self._admit(timeout_s, model_id=model_id)
+        name, replica = self._admit(timeout_s, model_id=model_id, affinity_key=affinity_key)
         actor_method = (
             replica.handle_request_proxy if proxy else replica.handle_request_streaming
         )
@@ -207,28 +231,28 @@ class _ReplicaSet:
             self._ensure_threads()  # demand pusher must see streaming load too
         return gen, name
 
-    def _pick_locked(self, model_id: str = "") -> Optional[str]:
+    def _pick_locked(self, affinity: str = "") -> Optional[str]:
         live = [n for n in self.replicas if self.ongoing.get(n, 0) < self.max_ongoing]
         if not live:
             return None
-        if model_id:
+        if affinity:
             # Model affinity (reference: multiplex-aware router): the replica
             # that last served this model already holds it loaded — reuse it
             # while it has capacity; otherwise fall through to pow-2 and
             # re-pin the affinity to the new pick.
-            sticky = self.model_affinity.get(model_id)
+            sticky = self.model_affinity.get(affinity)
             if sticky in live:
-                self.model_affinity.pop(model_id)  # LRU: move to newest
-                self.model_affinity[model_id] = sticky
+                self.model_affinity.pop(affinity)  # LRU: move to newest
+                self.model_affinity[affinity] = sticky
                 return sticky
         if len(live) == 1:
             pick = live[0]
         else:
             a, b = random.sample(live, 2)
             pick = a if self.ongoing.get(a, 0) <= self.ongoing.get(b, 0) else b
-        if model_id:
-            self.model_affinity.pop(model_id, None)
-            self.model_affinity[model_id] = pick
+        if affinity:
+            self.model_affinity.pop(affinity, None)
+            self.model_affinity[affinity] = pick
             while len(self.model_affinity) > self.AFFINITY_CAP:  # LRU bound
                 self.model_affinity.pop(next(iter(self.model_affinity)))
         return pick
@@ -318,13 +342,15 @@ class DeploymentResponse:
     DeploymentResponse). `result()` retries once on replica death."""
 
     def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict,
-                 model_id: str = ""):
+                 model_id: str = "", affinity_key: str = ""):
         self._rs = rs
         self._method = method
         self._args = args
         self._kwargs = kwargs
         self._model_id = model_id
-        self._ref, self._idx = rs.route(method, args, kwargs, model_id=model_id)
+        self._affinity_key = affinity_key
+        self._ref, self._idx = rs.route(method, args, kwargs, model_id=model_id,
+                                        affinity_key=affinity_key)
 
     def result(self, timeout: float | None = 60.0):
         import ray_tpu as rt
@@ -338,7 +364,8 @@ class DeploymentResponse:
                 if attempt == 2:
                     raise
                 self._ref, self._idx = self._rs.route(
-                    self._method, self._args, self._kwargs, model_id=self._model_id
+                    self._method, self._args, self._kwargs, model_id=self._model_id,
+                    affinity_key=self._affinity_key,
                 )
 
     def _to_object_ref(self):
@@ -352,11 +379,12 @@ class DeploymentResponseGenerator:
     is exhausted, errors, or is closed."""
 
     def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict,
-                 proxy: bool = False, model_id: str = ""):
+                 proxy: bool = False, model_id: str = "", affinity_key: str = ""):
         self._rs = rs
         self._released = False
         self._gen, self._name = rs.route_streaming(
-            method, args, kwargs, proxy=proxy, model_id=model_id
+            method, args, kwargs, proxy=proxy, model_id=model_id,
+            affinity_key=affinity_key,
         )
 
     def __iter__(self):
@@ -406,41 +434,47 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__", stream: bool = False,
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", affinity_key: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
         self.stream = stream
         self.multiplexed_model_id = multiplexed_model_id
+        self.affinity_key = affinity_key
 
     def options(self, method_name: Optional[str] = None, stream: Optional[bool] = None,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                affinity_key: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name,
             self.app_name,
             self.method_name if method_name is None else method_name,
             self.stream if stream is None else stream,
             self.multiplexed_model_id if multiplexed_model_id is None else multiplexed_model_id,
+            self.affinity_key if affinity_key is None else affinity_key,
         )
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.deployment_name, self.app_name, name,
-                                self.stream, self.multiplexed_model_id)
+                                self.stream, self.multiplexed_model_id,
+                                self.affinity_key)
 
     def remote(self, *args, **kwargs):
         rs = _replica_set(self.app_name, self.deployment_name)
         if self.stream:
             return DeploymentResponseGenerator(rs, self.method_name, args, kwargs,
-                                               model_id=self.multiplexed_model_id)
+                                               model_id=self.multiplexed_model_id,
+                                               affinity_key=self.affinity_key)
         return DeploymentResponse(rs, self.method_name, args, kwargs,
-                                  model_id=self.multiplexed_model_id)
+                                  model_id=self.multiplexed_model_id,
+                                  affinity_key=self.affinity_key)
 
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name, self.app_name,
                                    self.method_name, self.stream,
-                                   self.multiplexed_model_id))
+                                   self.multiplexed_model_id, self.affinity_key))
 
     def __repr__(self):
         return f"DeploymentHandle({self.app_name}/{self.deployment_name}.{self.method_name})"

@@ -336,11 +336,27 @@ class ProxyActor:
             from ray_tpu.serve.handle import DeploymentResponseGenerator, _replica_set
 
             rs = _replica_set(app, deployment)
+            # Replica affinity: a deployment-provided router policy maps the
+            # request to a sticky key (reference: PrefixCacheAffinityRouter —
+            # requests sharing a prompt prefix land on the replica whose
+            # engine caches those KV pages); clients can also pass an
+            # x-affinity-key header directly.
+            akey = headers.get("x-affinity-key", "")
+            router_fn = getattr(rs, "request_router", None)
+            if router_fn is None:
+                rs._maybe_refresh()  # router policy arrives with routing info
+                router_fn = getattr(rs, "request_router", None)
+            if router_fn is not None:
+                try:
+                    akey = str(router_fn(req) or akey)
+                except Exception:
+                    traceback.print_exc()
             # Retry replica death only before the first item: nothing has
             # reached the client yet, so re-routing is safe (mid-stream death
             # is surfaced — items were already delivered).
             for attempt in range(3):
-                gen = DeploymentResponseGenerator(rs, "__call__", (req,), {}, proxy=True)
+                gen = DeploymentResponseGenerator(rs, "__call__", (req,), {},
+                                                  proxy=True, affinity_key=akey)
                 try:
                     tag, first = next(gen)
                     break
