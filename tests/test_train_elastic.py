@@ -114,3 +114,83 @@ def test_fixed_policy_never_resizes(elastic_cluster):
     result = trainer.fit()
     assert result.error is None
     assert all(m["world_size"] == 1 for m in result.metrics_history)
+
+
+def _chaos_fn(config):
+    """Dies once at step 4 (first incarnation only) while the cluster is
+    simultaneously gaining a node — the resize/failure race."""
+    ctx = train.get_context()
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            start = json.load(open(os.path.join(d, "state.json")))["step"] + 1
+    for i in range(start, config["steps"]):
+        time.sleep(0.25)
+        die_marker = config["die_marker"]
+        if ctx.get_world_rank() == 0 and i == 4 and not os.path.exists(die_marker):
+            open(die_marker, "w").close()
+            os._exit(1)  # hard kill mid-gang, no cleanup
+        if ctx.get_world_rank() == 0:
+            d = tempfile.mkdtemp()
+            json.dump({"step": i}, open(os.path.join(d, "state.json"), "w"))
+            train.report(
+                {"step": i, "world_size": ctx.get_world_size()},
+                checkpoint=Checkpoint.from_directory(d),
+            )
+            marker = config.get("progress_marker")
+            if marker and i >= 2:
+                open(marker, "w").close()
+        else:
+            train.report({"step": i, "world_size": ctx.get_world_size()})
+
+
+def test_resize_racing_worker_failure(elastic_cluster):
+    """Chaos: a node joins (upscale trigger) in the same window a worker
+    hard-dies. The gang must restart from the checkpoint, the resize must
+    still land, and no step may be lost (VERDICT r3 weak #8)."""
+    tmp = tempfile.mkdtemp()
+    marker = os.path.join(tmp, "progress")
+    die_marker = os.path.join(tmp, "died_once")
+    trainer = DataParallelTrainer(
+        _chaos_fn,
+        train_loop_config={"steps": 10, "progress_marker": marker,
+                           "die_marker": die_marker},
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(
+            name="chaos", storage_path=tmp,
+            failure_config=FailureConfig(max_failures=2),
+        ),
+        scaling_policy=ElasticScalingPolicy(
+            ScalingConfig(num_workers=2, resources_per_worker={"CPU": 1}),
+            min_workers=1, max_workers=2, resize_cooldown_s=0.5,
+        ),
+        controller_as_actor=False,
+    )
+
+    import threading
+
+    def add_node_when_progressing():
+        deadline = time.time() + 60
+        while not os.path.exists(marker) and time.time() < deadline:
+            time.sleep(0.1)
+        # Node joins JUST before the step-4 death: the upscale decision and
+        # the gang failure land in the same window.
+        elastic_cluster.add_node(num_cpus=1)
+
+    t = threading.Thread(target=add_node_when_progressing, daemon=True)
+    t.start()
+    result = trainer.fit()
+    t.join()
+    assert result.error is None, result.error
+    assert os.path.exists(die_marker), "failure injection never fired"
+    with result.checkpoint.as_directory() as d:
+        assert json.load(open(os.path.join(d, "state.json")))["step"] == 9
+    steps = [m["step"] for m in result.metrics_history]
+    sizes = [m["world_size"] for m in result.metrics_history]
+    # Every step reached the metrics stream (restart resumes from the last
+    # checkpoint, so repeats are legal; holes are not).
+    assert set(steps) >= set(range(10)), steps
+    assert steps[-1] == 9
+    # The resize survived the chaos: the run ends at the grown world size.
+    assert sizes[-1] == 2, sizes
