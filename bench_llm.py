@@ -335,12 +335,14 @@ def main():
             "engine": engine_r,
             "serve": serve_r,
             "openai": results["openai"],
-            "note": "serve phase co-locates 32 client threads + HTTP proxy + "
-                    "replica process on this host's ONE cpu core; the "
-                    "engine->client gap is host-side contention, not engine "
-                    "queueing (serve-phase decode rate drops the same way). "
-                    "Loaded p50 vs unloaded reflects serializing 32 "
-                    "simultaneous 512-token prefills through one chip.",
+            "note": "serve/openai phases co-locate 32 client threads + HTTP "
+                    "proxy + replica process on this host's ONE cpu core; the "
+                    "engine->client gap is the measuring fleet itself — "
+                    "PROFILES.md round 4 attributes it experimentally (proxy "
+                    "round trip 1.5-1.9ms under load; a lone probe client "
+                    "sees engine-level TTFT through the same proxy). Loaded "
+                    "p50 vs unloaded reflects serializing 32 simultaneous "
+                    "512-token prefills through one chip.",
         },
     }
     print(json.dumps(result))
