@@ -19,7 +19,7 @@ import pytest
 TOKEN = "start-cli-test-token"
 
 
-def _cli(env, *args, timeout=120):
+def _cli(env, *args, timeout=300):
     return subprocess.run(
         [sys.executable, "-m", "ray_tpu", *args],
         env=env, capture_output=True, text=True, timeout=timeout,
@@ -34,12 +34,14 @@ def cli_cluster(tmp_path):
     addr_file = str(tmp_path / "head_addr")
 
     head = _cli(env, "start", "--head", "--port", "0", "--num-cpus", "4",
-                "--no-tpu-autodetect", "--address-file", addr_file)
+                "--no-tpu-autodetect", "--address-file", addr_file,
+                "--startup-timeout", "240")
     assert head.returncode == 0, f"head start failed:\n{head.stdout}\n{head.stderr}"
     addr = open(addr_file).read().strip()
 
     join = _cli(env, "start", f"--address={addr}", "--num-cpus", "4",
-                "--resources", '{"joiner": 1}', "--no-tpu-autodetect")
+                "--resources", '{"joiner": 1}', "--no-tpu-autodetect",
+                "--startup-timeout", "240")
     assert join.returncode == 0, f"join failed:\n{join.stdout}\n{join.stderr}"
 
     yield addr, env
