@@ -16,6 +16,7 @@ Role-equivalent to the reference's RLlib core split (rllib/):
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.sac import SAC, SACConfig
 from ray_tpu.rl.replay_buffer import (
     PrioritizedReplayBuffer,
     ReplayBuffer,
@@ -29,6 +30,8 @@ __all__ = [
     "IMPALAConfig",
     "PPO",
     "PPOConfig",
+    "SAC",
+    "SACConfig",
     "PrioritizedReplayBuffer",
     "ReplayBuffer",
     "ReplayBufferActor",

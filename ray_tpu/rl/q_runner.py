@@ -17,43 +17,40 @@ import numpy as np
 from ray_tpu.rl.module import np_logits_values
 
 
-class QEnvRunner:
-    def __init__(self, env_name: str, num_envs: int, buffer, seed: int = 0,
-                 throttle_sleep_s: float = 0.05):
+class TransitionCollector:
+    """Shared off-policy collect loop: gymnasium next-step-autoreset junk
+    filtering, episode bookkeeping, buffer push + throttle. Subclasses
+    implement _select_actions(obs) -> actions (DQN: epsilon-greedy ints;
+    SAC: tanh-Gaussian floats) and set up envs/buffer/rng in __init__.
+    The autoreset invariant lives HERE exactly once."""
+
+    def _init_collector(self, env_name: str, num_envs: int, buffer, seed: int,
+                        throttle_sleep_s: float):
         import gymnasium as gym
 
         self.envs = gym.make_vec(env_name, num_envs=num_envs, vectorization_mode="sync")
         self.num_envs = num_envs
         self.buffer = buffer
         self.rng = np.random.default_rng(seed)
-        self.params = None
-        self.epsilon = 1.0
         self.throttle_sleep_s = throttle_sleep_s
         self.obs, _ = self.envs.reset(seed=seed)
         self._ep_return = np.zeros(num_envs, np.float64)
         self._ep_len = np.zeros(num_envs, np.int64)
         self._prev_done = np.zeros(num_envs, bool)  # next-step autoreset junk
 
-    def set_weights(self, params: dict, epsilon: float) -> bool:
-        self.params = params
-        self.epsilon = float(epsilon)
-        return True
+    def _select_actions(self, obs) -> "np.ndarray":
+        raise NotImplementedError
 
     def collect(self, n_steps: int) -> dict:
         """Run n_steps vector-env steps; push valid transitions to the buffer
         actor; returns episode stats + whether the buffer throttled us."""
         import ray_tpu as rt
 
-        N = self.num_envs
         episode_returns: list[float] = []
         throttled = False
         obs_l, act_l, rew_l, nxt_l, term_l = [], [], [], [], []
         for _ in range(n_steps):
-            q, _ = np_logits_values(self.params, self.obs)
-            greedy = np.argmax(q, axis=1)
-            random_a = self.rng.integers(0, q.shape[1], N)
-            explore = self.rng.random(N) < self.epsilon
-            actions = np.where(explore, random_a, greedy).astype(np.int64)
+            actions = self._select_actions(self.obs)
             prev_obs = self.obs
             self.obs, rew, term, trunc, _ = self.envs.step(actions)
             done = np.logical_or(term, trunc)
@@ -94,3 +91,23 @@ class QEnvRunner:
     def close(self) -> bool:
         self.envs.close()
         return True
+
+
+class QEnvRunner(TransitionCollector):
+    def __init__(self, env_name: str, num_envs: int, buffer, seed: int = 0,
+                 throttle_sleep_s: float = 0.05):
+        self._init_collector(env_name, num_envs, buffer, seed, throttle_sleep_s)
+        self.params = None
+        self.epsilon = 1.0
+
+    def set_weights(self, params: dict, epsilon: float) -> bool:
+        self.params = params
+        self.epsilon = float(epsilon)
+        return True
+
+    def _select_actions(self, obs):
+        q, _ = np_logits_values(self.params, obs)
+        greedy = np.argmax(q, axis=1)
+        random_a = self.rng.integers(0, q.shape[1], self.num_envs)
+        explore = self.rng.random(self.num_envs) < self.epsilon
+        return np.where(explore, random_a, greedy).astype(np.int64)
