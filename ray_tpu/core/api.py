@@ -110,6 +110,14 @@ class Cluster:
             import secrets
 
             self.config.auth_token = secrets.token_hex(16)
+            # Minted into a (possibly process-global) Config: remember to
+            # scrub it on shutdown, or the NEXT session in this process
+            # inherits a dead cluster's token and fails every MAC check
+            # against a freshly-tokened cluster (the round-4 start-CLI
+            # order-sensitive ConnectionLost).
+            self._minted_token = True
+        else:
+            self._minted_token = False
         if self.config.auth_token:
             from ray_tpu.core import rpc as _rpc
 
@@ -193,6 +201,19 @@ class Cluster:
             except OSError:
                 pass
             self._token_file = None
+        if self._minted_token:
+            # Restore whatever the environment pins (usually ""): a later
+            # init(address=...) in this process must fall through to the
+            # session-token-file / RAYTPU_AUTH_TOKEN discovery path instead
+            # of reusing this dead session's secret. Scrub the rpc-module
+            # copy too — the direct-Cluster path (no api.shutdown) must not
+            # keep MAC-tagging frames with the dead secret.
+            from ray_tpu.core import rpc as _rpc
+
+            self.config.auth_token = type(self.config)().apply_env().auth_token
+            if not self.config.auth_token:
+                _rpc.set_auth_token(None)
+            self._minted_token = False
 
 
 def init(

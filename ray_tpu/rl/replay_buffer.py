@@ -12,6 +12,8 @@ machinery).
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 
@@ -67,11 +69,29 @@ class ReplayBuffer:
         self._store: dict[str, np.ndarray] = {}
         self._next = 0
         self._size = 0
+        # The buffer actor runs with max_concurrency > 1 (concurrent
+        # collector pushes + learner samples). Every mutation/read of the
+        # ring state happens under this lock, so a sample can never observe
+        # a partially-allocated store (the round-4 KeyError: 'actions' race
+        # was two first-push threads splitting the lazy allocation).
+        self._lock = threading.Lock()
 
     def __len__(self) -> int:
         return self._size
 
     def add_batch(self, batch: dict) -> int:
+        with self._lock:
+            return self._add_batch(batch)
+
+    def sample(self, batch_size: int) -> dict | None:
+        with self._lock:
+            return self._sample(batch_size)
+
+    def update_priorities(self, indices, priorities) -> None:
+        with self._lock:
+            self._update_priorities(indices, priorities)
+
+    def _add_batch(self, batch: dict) -> int:
         n = len(next(iter(batch.values())))
         if not self._store:
             for k, v in batch.items():
@@ -87,7 +107,7 @@ class ReplayBuffer:
     def _on_added(self, idx, batch) -> int:
         return self._size
 
-    def sample(self, batch_size: int) -> dict | None:
+    def _sample(self, batch_size: int) -> dict | None:
         if self._size == 0:
             return None
         idx = self.rng.integers(0, self._size, batch_size)
@@ -96,7 +116,7 @@ class ReplayBuffer:
         out["weights"] = np.ones(batch_size, np.float32)
         return out
 
-    def update_priorities(self, indices, priorities) -> None:
+    def _update_priorities(self, indices, priorities) -> None:
         pass  # uniform: no-op
 
 
@@ -124,7 +144,7 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         self.tree.set(idx, np.full(len(idx), self._max_priority ** self.alpha))
         return self._size
 
-    def sample(self, batch_size: int) -> dict | None:
+    def _sample(self, batch_size: int) -> dict | None:
         if self._size == 0 or self.tree.total <= 0:
             return None
         # Stratified prefix sums de-correlate the draw.
@@ -140,7 +160,7 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         out["weights"] = weights
         return out
 
-    def update_priorities(self, indices, priorities) -> None:
+    def _update_priorities(self, indices, priorities) -> None:
         priorities = np.abs(np.asarray(priorities, np.float64))
         priorities = np.where(np.isfinite(priorities), priorities, self.MAX_PRIORITY)
         priorities = np.clip(priorities, 0.0, self.MAX_PRIORITY) + self.eps
@@ -170,24 +190,30 @@ class ReplayBufferActor:
         self.max_ahead_ratio = max_ahead_ratio
         self.warmup = warmup
         self.add_times: list[float] = []  # for overlap diagnostics/tests
+        # The actor runs with max_concurrency > 1; the backpressure counters
+        # are read-modify-write state and need the same atomicity as the
+        # ring buffer itself.
+        self._counter_lock = threading.Lock()
 
     def add_batch(self, batch: dict) -> dict:
         import time
 
         n = len(next(iter(batch.values())))
         self.buf.add_batch(batch)
-        self.added += n
-        self.add_times.append(time.monotonic())
-        throttle = (
-            self.added > self.warmup
-            and self.added > self.sampled * self.max_ahead_ratio
-        )
+        with self._counter_lock:
+            self.added += n
+            self.add_times.append(time.monotonic())
+            throttle = (
+                self.added > self.warmup
+                and self.added > self.sampled * self.max_ahead_ratio
+            )
         return {"size": len(self.buf), "throttle": throttle}
 
     def sample(self, batch_size: int):
         out = self.buf.sample(batch_size)
         if out is not None:
-            self.sampled += batch_size
+            with self._counter_lock:
+                self.sampled += batch_size
         return out
 
     def update_priorities(self, indices, priorities) -> bool:

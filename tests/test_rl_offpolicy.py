@@ -45,6 +45,48 @@ def _mk_batch(n, rng, obs_dim=4):
     }
 
 
+def test_concurrent_first_push_cannot_split_allocation():
+    """Regression (round-4 SAC flake): two collectors' FIRST add_batch calls
+    racing on an empty buffer must not split the lazy store allocation —
+    thread B used to see a partially-built store (truthy after 'obs'), skip
+    allocation, and die with KeyError: 'actions'. Mutation is now atomic."""
+    import threading
+
+    errors = []
+    for trial in range(50):
+        buf = ReplayBuffer(capacity=256, seed=trial)
+        barrier = threading.Barrier(3)
+
+        def push():
+            try:
+                barrier.wait()
+                for _ in range(4):
+                    buf.add_batch(_mk_batch(16, np.random.default_rng(trial)))
+            except Exception as e:  # noqa: BLE001 — collecting for assert
+                errors.append(e)
+
+        def drain():
+            try:
+                barrier.wait()
+                for _ in range(8):
+                    s = buf.sample(8)
+                    if s is not None:
+                        assert set(s) >= {"obs", "actions", "rewards",
+                                          "next_obs", "terms"}
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=push), threading.Thread(target=push),
+              threading.Thread(target=drain)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert not errors, errors
+    s = buf.sample(32)
+    assert s is not None and s["actions"].shape == (32,)
+
+
 def test_uniform_buffer_ring_semantics():
     rng = np.random.default_rng(1)
     buf = ReplayBuffer(capacity=100, seed=1)

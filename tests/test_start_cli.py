@@ -50,10 +50,34 @@ def cli_cluster(tmp_path):
     assert "stopped" in stop.stdout
 
 
+def test_minted_token_scrubbed_on_shutdown():
+    """Regression (round-4 order-sensitive ConnectionLost): an in-process
+    session auto-mints its RPC token into the process-global Config; shutdown
+    must scrub it, or the next init(address=...) in the same process
+    authenticates to a fresh cluster with the dead session's secret and every
+    frame fails the MAC check."""
+    import ray_tpu as rt
+    from ray_tpu.core.config import get_config
+
+    assert not get_config().auth_token
+    rt.init(num_cpus=1)
+    try:
+        assert get_config().auth_token, "in-process cluster should auto-mint"
+    finally:
+        rt.shutdown()
+    assert not get_config().auth_token, "stale session token leaked into global config"
+
+
 def test_start_cli_two_process_cluster(cli_cluster):
     addr, env = cli_cluster
     import ray_tpu as rt
     from ray_tpu.core import api
+
+    # The round-4 flake fired only when OTHER tests' sessions ran first in
+    # this process: reproduce that deliberately with a throwaway in-process
+    # session before connecting to the CLI-started cluster.
+    rt.init(num_cpus=1)
+    rt.shutdown()
 
     rt.init(address=addr)  # token from RAYTPU_AUTH_TOKEN (multi-host path)
     try:
