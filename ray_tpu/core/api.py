@@ -93,6 +93,12 @@ def _write_session_token_file(address: str, token: str) -> str | None:
         return None
 
 
+# Live in-process Clusters. The auth-token scrub on shutdown must not pull
+# the shared session token out from under another Cluster that inherited it
+# (both would be using the same process-global Config + rpc key).
+_LIVE_CLUSTERS: list = []
+
+
 class Cluster:
     """Multi-node cluster on one machine (reference: cluster_utils.Cluster)."""
 
@@ -131,6 +137,7 @@ class Cluster:
                 self.controller_addr, self.config.auth_token
             )
         self.daemons: list[NodeDaemon] = []
+        _LIVE_CLUSTERS.append(self)
         if initialize_head:
             self.add_node(**(head_node_args or {}))
 
@@ -201,13 +208,21 @@ class Cluster:
             except OSError:
                 pass
             self._token_file = None
-        if self._minted_token:
+        if self in _LIVE_CLUSTERS:
+            _LIVE_CLUSTERS.remove(self)
+        if self._minted_token and _LIVE_CLUSTERS:
+            # A later-created Cluster inherited this token; hand the scrub
+            # duty to it so the LAST sharer cleans up.
+            _LIVE_CLUSTERS[0]._minted_token = True
+            self._minted_token = False
+        if self._minted_token and not _LIVE_CLUSTERS:
             # Restore whatever the environment pins (usually ""): a later
             # init(address=...) in this process must fall through to the
             # session-token-file / RAYTPU_AUTH_TOKEN discovery path instead
             # of reusing this dead session's secret. Scrub the rpc-module
             # copy too — the direct-Cluster path (no api.shutdown) must not
-            # keep MAC-tagging frames with the dead secret.
+            # keep MAC-tagging frames with the dead secret. Skipped while
+            # another live Cluster in this process shares the token.
             from ray_tpu.core import rpc as _rpc
 
             self.config.auth_token = type(self.config)().apply_env().auth_token

@@ -213,11 +213,20 @@ def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
     from ray_tpu import serve
     from ray_tpu.llm.engine import EngineConfig
 
-    slots = EngineConfig(**(engine_config or {})).max_slots
+    ec = EngineConfig(**(engine_config or {}))
+    aopts = dict(ray_actor_options or {})
+    if ec.tensor_parallel > 1:
+        # Tensor-parallel replica: gang-schedule it onto a host advertising
+        # that many chips (reference: TP degree -> placement-group bundles,
+        # vllm_models.py:233-238). The worker's TPU_VISIBLE_CHIPS isolation
+        # (accel/tpu.py) then exposes exactly those chips to the engine mesh.
+        aopts.setdefault("resources", {}).setdefault(
+            "TPU", float(ec.tensor_parallel)
+        )
     dep = serve.deployment(LLMServer).options(
         name="llm",
         num_replicas=num_replicas,
-        max_ongoing_requests=max_ongoing_requests or slots,
-        ray_actor_options=ray_actor_options or {},
+        max_ongoing_requests=max_ongoing_requests or ec.max_slots,
+        ray_actor_options=aopts,
     )
     return dep.bind(model_config, engine_config, warmup_buckets)

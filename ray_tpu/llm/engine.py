@@ -26,6 +26,13 @@ llm/_internal/serve/engines/vllm/vllm_engine.py:174):
   tunneled-chip round-trip latency.
 - GQA cache: K/V stored at kv-head count (the HBM saving is what makes long
   contexts fit); the paged kernel reads grouped heads directly.
+- Tensor-parallel serving (EngineConfig.tensor_parallel > 1): params shard
+  Megatron-style and the KV pools shard by kv_heads over a `tensor` mesh
+  axis (parallel/), so a model bigger than one chip's HBM serves from a
+  gang of chips; XLA inserts the ICI collectives, the Pallas kernels run
+  per-shard under shard_map, and the host scheduler is unchanged. The
+  reference reaches the same capability by mapping TP degrees onto
+  placement-group bundles for vLLM (vllm_models.py:233-238).
 
 TTFT is measured from request arrival to its first sampled token (prefill
 completes inside that window), the standard serving definition.
@@ -85,6 +92,18 @@ class EngineConfig:
     # ceil((prompt + max_tokens + decode_block)/page_size) pages per request
     # and queues when the pool is dry.
     total_pages: int = 0
+    # Tensor-parallel serving degree. >1 shards the model AND the KV cache
+    # over a `tensor` mesh axis of that many local devices (reference: TP
+    # degree -> placement-group bundle mapping, vllm_models.py:233-238; the
+    # sharded execution itself lives in vLLM — here it is native): params
+    # shard by heads/ffn/vocab (Megatron split, parallel/sharding.py tp()),
+    # KV pools shard by kv_heads, page tables/lengths/sampling state stay
+    # replicated, and the host-side scheduler is unchanged. Serving capacity
+    # becomes k chips' HBM instead of one. Requires n_heads, kv_heads, d_ff
+    # and vocab_size divisible by the degree. NOTE: this box exposes ONE
+    # real TPU chip — multi-chip runs are validated on the virtual CPU mesh
+    # (tests + dryrun_multichip) and single-chip on hardware.
+    tensor_parallel: int = 1
     # Prefix KV cache (paged layout only; reference: vLLM automatic prefix
     # caching + PrefixCacheAffinityRouter, prefix_aware_router.py:39). A
     # retired request's PROMPT pages stay in an LRU cache keyed by the
@@ -120,9 +139,14 @@ def _attn_proj(h, lp, cfg, dt):
     return q, k, v
 
 
-def _prefill_layer(x, lp, cfg: TransformerConfig, positions, seg):
+def _prefill_layer(x, lp, cfg: TransformerConfig, positions, seg, mesh=None):
     """Standard causal layer over the (padded) prompt; returns new K/V for
-    the cache. seg masks pad columns (pad tokens are their own segment)."""
+    the cache. seg masks pad columns (pad tokens are their own segment).
+
+    mesh: tensor-parallel serving — heads are sharded over mesh["tensor"],
+    so the Pallas flash kernel runs per-shard under shard_map (a bare
+    pallas_call is an opaque custom-call GSPMD would gather around); the
+    einsum reference path is GSPMD-partitionable as-is."""
     from ray_tpu.ops.attention import flash_attention, mha_reference
 
     dt = x.dtype
@@ -130,7 +154,24 @@ def _prefill_layer(x, lp, cfg: TransformerConfig, positions, seg):
     q, k, v = _attn_proj(h, lp, cfg, dt)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if jax.default_backend() == "tpu" and x.shape[1] % 128 == 0:
+    use_flash = jax.default_backend() == "tpu" and x.shape[1] % 128 == 0
+    tp_sharded = mesh is not None and mesh.shape.get("tensor", 1) > 1
+    if use_flash and tp_sharded:
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.parallel._shard_map import shard_map
+
+        def _flash_shard(q_, k_, v_, seg_):
+            return flash_attention(q_, k_, v_, causal=True, segment_ids=seg_)
+
+        hs = P(None, None, "tensor", None)
+        o = shard_map(
+            _flash_shard,
+            mesh=mesh,
+            in_specs=(hs, hs, hs, P(None, None)),
+            out_specs=hs,
+        )(q, k, v, seg)
+    elif use_flash:
         o = flash_attention(q, k, v, causal=True, segment_ids=seg)
     else:
         o = mha_reference(q, k, v, causal=True, segment_ids=seg)
@@ -204,24 +245,83 @@ class LLMEngine:
             self.ec = dataclasses.replace(
                 self.ec, total_pages=self.ec.max_slots * (S // ps) + 1
             )
-        self.params = params if params is not None else init_params(jax.random.PRNGKey(self.ec.seed), cfg)
+        # Tensor-parallel mesh: params shard Megatron-style, KV pools shard
+        # by kv_heads; everything else (page tables, lengths, sampling state)
+        # is replicated, so the host scheduler below is layout-oblivious.
+        tp = self.ec.tensor_parallel
+        self.mesh = None
+        param_shardings = None
+        if tp > 1:
+            from ray_tpu.models.transformer import param_logical_axes
+            from ray_tpu.parallel.mesh import MeshSpec
+            from ray_tpu.parallel.sharding import ShardingStrategy, logical_sharding
+
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(
+                    f"tensor_parallel={tp} but only {len(devs)} devices visible "
+                    "(gang-schedule the replica with that many chips)"
+                )
+            for dim_name, dim in (("n_heads", cfg.n_heads), ("kv_heads", cfg.kv_heads),
+                                  ("d_ff", cfg.d_ff), ("vocab_size", cfg.vocab_size)):
+                if dim % tp:
+                    raise ValueError(
+                        f"{dim_name}={dim} not divisible by tensor_parallel={tp}"
+                    )
+            self.mesh = MeshSpec(tensor=tp).build(devs[:tp])
+            param_shardings = logical_sharding(
+                self.mesh, ShardingStrategy.tp(), param_logical_axes(cfg)
+            )
+        if params is not None:
+            # Externally-supplied weights (checkpoint load): reshard per-leaf.
+            self.params = (
+                jax.device_put(params, param_shardings) if param_shardings else params
+            )
+        elif param_shardings is not None:
+            # Init DIRECTLY sharded: the whole point of TP serving is a model
+            # bigger than one chip's HBM — materializing the full tree on one
+            # device before resharding would OOM exactly that model.
+            self.params = jax.jit(
+                lambda: init_params(jax.random.PRNGKey(self.ec.seed), cfg),
+                out_shardings=param_shardings,
+            )()
+        else:
+            self.params = init_params(jax.random.PRNGKey(self.ec.seed), cfg)
         L = cfg.n_layers
         B = self.ec.max_slots
+
+        def _pool_zeros(shape, pool_spec):
+            if self.mesh is None:
+                return jnp.zeros(shape, cfg.dtype)
+            from jax.sharding import NamedSharding
+
+            # Allocate directly sharded: a replicated-then-device_put pool
+            # would materialize the full multi-GB buffer on one chip first.
+            return jax.jit(
+                lambda: jnp.zeros(shape, cfg.dtype),
+                out_shardings=NamedSharding(self.mesh, pool_spec),
+            )()
+
+        from jax.sharding import PartitionSpec as _P
+
         if self.paged:
             P_total = self.ec.total_pages
             self.ppseq = S // ps  # page-table width (max pages per sequence)
             # Linear page pool: position (page, offset) lives at page*ps + offset.
             pool_shape = (L, cfg.kv_heads, P_total * ps, cfg.head_dim)
-            self.k_pages = jnp.zeros(pool_shape, cfg.dtype)
-            self.v_pages = jnp.zeros(pool_shape, cfg.dtype)
+            kv_spec = _P(None, "tensor", None, None)
+            self.k_pages = _pool_zeros(pool_shape, kv_spec)
+            self.v_pages = _pool_zeros(pool_shape, kv_spec)
             self.free_pages: deque = deque(range(1, P_total))  # page 0 = dead sink
             self.page_tables = np.zeros((B, self.ppseq), np.int32)
             self.d_page_tables = jnp.zeros((B, self.ppseq), jnp.int32)
         else:
             # Dense per-slot cache (one virtual page of max_seq per slot).
             self.ppseq = 1
-            self.k_pages = jnp.zeros((L, B, S, cfg.kv_heads, cfg.head_dim), cfg.dtype)
-            self.v_pages = jnp.zeros_like(self.k_pages)
+            dense_shape = (L, B, S, cfg.kv_heads, cfg.head_dim)
+            kv_spec = _P(None, None, None, "tensor", None)
+            self.k_pages = _pool_zeros(dense_shape, kv_spec)
+            self.v_pages = _pool_zeros(dense_shape, kv_spec)
             self.free_pages = deque()
             self.page_tables = np.zeros((B, 1), np.int32)
             self.d_page_tables = jnp.zeros((B, 1), jnp.int32)
@@ -322,7 +422,7 @@ class LLMEngine:
 
         def scan_fn(h, xs):
             lp, ck_l, cv_l = xs
-            h, k_new, v_new = _prefill_layer(h, lp, cfg, pos, seg)
+            h, k_new, v_new = _prefill_layer(h, lp, cfg, pos, seg, mesh=self.mesh)
             # [1,P,KV,Hd] -> [KV,P,Hd]; scatter page chunks into the pool.
             kt = k_new[0].transpose(1, 0, 2).astype(ck_l.dtype)
             vt = v_new[0].transpose(1, 0, 2).astype(cv_l.dtype)
@@ -380,6 +480,7 @@ class LLMEngine:
                     cv_l.reshape(cfg.kv_heads, -1, ps, cfg.head_dim),
                     lens + 1,
                     page_tables,
+                    mesh=self.mesh,
                 )  # [B, H, Hd]
                 h = h + jnp.einsum("bhk,hkd->bd", o, lp["wo"].astype(dt))[:, None, :]
                 hh = _rms_norm(h, lp["ffn_norm"])
@@ -430,7 +531,7 @@ class LLMEngine:
 
         def scan_fn(h, xs):
             lp, ck_l, cv_l = xs
-            h, k_new, v_new = _prefill_layer(h, lp, cfg, pos, seg)
+            h, k_new, v_new = _prefill_layer(h, lp, cfg, pos, seg, mesh=self.mesh)
             ck_l = jax.lax.dynamic_update_slice(ck_l, k_new.astype(ck_l.dtype), (slot, 0, 0, 0))
             cv_l = jax.lax.dynamic_update_slice(cv_l, v_new.astype(cv_l.dtype), (slot, 0, 0, 0))
             return h, (ck_l, cv_l)

@@ -148,14 +148,41 @@ def _paged_pallas(q, k_pages, v_pages, lengths, page_indices, *, scale, interpre
 
 
 def paged_attention(q, k_pages, v_pages, lengths, page_indices, scale=None,
-                    interpret=False):
+                    interpret=False, mesh=None, head_axis="tensor"):
     """Paged decode attention. q: [B, H, D] (one query token per sequence);
     k_pages/v_pages: [KV, P_total, page_size, D]; lengths: [B] valid tokens
     per sequence including the current one; page_indices: [B, pages_per_seq]
     (entries past a sequence's length must still be valid page ids — use 0).
 
     Pallas kernel on TPU (or interpret=True); jnp reference elsewhere.
+
+    mesh: tensor-parallel serving (llm/engine.py) — the head axes (H of q, KV
+    of the page pools) are sharded over ``mesh[head_axis]`` and the kernel is
+    shard_map'd: each device attends its own head shard against its own KV
+    pool shard (embarrassingly parallel — GQA groups never straddle shards
+    because callers validate KV % degree == 0). Without the explicit map a
+    Pallas call is an opaque custom-call GSPMD would have to gather around.
     """
+    if mesh is not None and mesh.shape.get(head_axis, 1) > 1:
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.parallel._shard_map import shard_map
+
+        inner = partial(paged_attention, scale=scale, interpret=interpret)
+        return shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(
+                P(None, head_axis, None),
+                P(head_axis, None, None, None),
+                P(head_axis, None, None, None),
+                P(None),
+                P(None, None),
+            ),
+            out_specs=P(None, head_axis, None),
+        )(q, k_pages, v_pages, lengths, page_indices)
     B, H, D = q.shape
     KV = k_pages.shape[0]
     if H % KV:
