@@ -89,6 +89,7 @@ class LLMServer:
                         self._done[rid] = {
                             "tokens": ev["tokens"],
                             "ttft_s": self._ttft.pop(rid, ev.get("ttft_s")),
+                            "finish_reason": ev.get("finish_reason"),
                         }
                 self._cond.notify_all()
 
@@ -144,6 +145,7 @@ class LLMServer:
                 }
                 if out["finished"]:
                     out["tokens"] = ev.get("tokens", [])
+                    out["finish_reason"] = ev.get("finish_reason")
                     finished = True
                 yield out
                 if finished:
@@ -198,7 +200,10 @@ class LLMServer:
 
     def stats(self) -> dict:
         active = sum(1 for s in self.engine.slots if s is not None)
-        return {"active_slots": active, "waiting": len(self.engine.waiting)}
+        out = {"active_slots": active, "waiting": len(self.engine.waiting)}
+        if self.engine.ec.prefix_cache:
+            out["prefix_cache"] = self.engine.prefix_cache_stats
+        return out
 
     def __raytpu_exit__(self):
         self._stop = True

@@ -104,16 +104,25 @@ class EngineConfig:
     # real TPU chip — multi-chip runs are validated on the virtual CPU mesh
     # (tests + dryrun_multichip) and single-chip on hardware.
     tensor_parallel: int = 1
+    # Candidate cap for truncated (top-k/top-p) sampling rows; see
+    # sampling.TOPK_CAP for the nucleus-width caveat. Raise for workloads
+    # sampling high-entropy distributions with top_p near 1.
+    sample_topk_cap: int = 128
     # Prefix KV cache (paged layout only; reference: vLLM automatic prefix
     # caching + PrefixCacheAffinityRouter, prefix_aware_router.py:39). A
-    # retired request's PROMPT pages stay in an LRU cache keyed by the
-    # prompt's hash; an exact-prompt hit copies them on-device (a few MB
-    # gather vs ~100s of ms of prefill compute) and starts decoding at
-    # position P-1 — the fused decode block re-derives the last position's
-    # KV (identical bytes) and emits the first token with NO prefill.
-    # Partial-prefix (tail-prefill over cached pages) is a documented
-    # follow-up: it needs a chunked-prefill kernel that attends to cached
-    # pages.
+    # retired request's PROMPT pages stay in an LRU cache under CHAINED
+    # digests — one entry per page-aligned prefix plus the full prompt, the
+    # pages refcounted across entries (vLLM's caching is block-granular for
+    # the same reason):
+    # - exact hit: copy the cached pages on-device (a few MB gather vs
+    #   ~100s of ms of prefill compute) and start decoding at position P-1
+    #   — the fused decode block re-derives that position's KV (identical
+    #   bytes) and emits the first token with NO prefill.
+    # - partial hit (the canonical shared-system-prompt workload: a new
+    #   prompt EXTENDS a cached page-aligned prefix): copy the matched
+    #   pages, then a chunked TAIL prefill embeds only the new tokens,
+    #   attending to the cached pages gathered from the pool — prefill
+    #   compute scales with the tail, not the prompt.
     prefix_cache: bool = False
 
 
@@ -128,7 +137,9 @@ class _Slot:
     first_token_at: Optional[float] = None
     stop_ids: tuple = ()  # per-request stop tokens (on top of engine eos)
     ignore_eos: bool = False
-    cache_key: Optional[bytes] = None  # cache this prompt's pages at retire
+    # Prompt tokens, kept only when this prompt's pages should enter the
+    # prefix cache at retire (miss or partial hit; an exact hit adds nothing).
+    prompt_tokens: Optional[np.ndarray] = None
     prompt_len: int = 0
 
 
@@ -211,9 +222,9 @@ def _decode_layer_dense(x, lp, ck, cv, cfg: TransformerConfig, lengths):
     return x, ck, cv
 
 
-def _sample1(logits, temp, top_p, top_k, key):
+def _sample1(logits, temp, top_p, top_k, key, cap=None):
     """Single-row wrapper over the batched per-request sampler."""
-    return sample_batch(logits[None], temp[None], top_p[None], top_k[None], key)[0]
+    return sample_batch(logits[None], temp[None], top_p[None], top_k[None], key, cap=cap)[0]
 
 
 class LLMEngine:
@@ -344,12 +355,18 @@ class LLMEngine:
         self.waiting: deque = deque()
         self._key = jax.random.PRNGKey(self.ec.seed + 1)
         self._prefill_jit: dict[int, Any] = {}
-        # Prefix KV cache: sha1(prompt) -> {"pages": [...], "prompt_len": n},
-        # LRU-ordered; entries own their pages until evicted.
+        # Prefix KV cache: chained digests — sha1(tokens[:n]) -> {"pages":
+        # (...), "prompt_len": n} for every page-aligned prefix n of a
+        # retired prompt plus its full length, LRU-ordered. Pages are shared
+        # across the chain entries of one prompt and refcounted
+        # (_page_refs); a page returns to the free list only when its last
+        # referencing entry is evicted.
         from collections import OrderedDict
 
         self._prefix_cache: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._page_refs: dict[int, int] = {}
         self.prefix_hits = 0
+        self.prefix_partial_hits = 0
         self.prefix_misses = 0
         if self.ec.prefix_cache and not self.paged:
             raise ValueError("prefix_cache requires kv_layout='paged'")
@@ -379,6 +396,16 @@ class LLMEngine:
             # Padded rows copy page 0 onto itself (the dead sink) — static
             # [ppseq] shape, one compiled program for any hit size.
             self._copy_pages_jit = jax.jit(_copy_pages_impl, donate_argnums=(0, 1))
+            # Context-page buckets for the tail-prefill program (partial
+            # prefix hits): powers of two up to the page-table width, so the
+            # compiled-program count stays |buckets| x log(ppseq).
+            cs, c = [], 1
+            while c < self.ppseq:
+                cs.append(c)
+                c *= 2
+            cs.append(self.ppseq)
+            self.c_buckets = tuple(sorted(set(cs)))
+            self._tail_jit: dict[tuple, Any] = {}
         if self.paged:
             self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2), static_argnums=(6,))
         else:
@@ -445,7 +472,8 @@ class LLMEngine:
         x = _rms_norm(x, params["final_norm"])
         last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
         logits = last @ params["lm_head"].astype(cfg.dtype)
-        tok = _sample1(logits.astype(jnp.float32), temp, top_p, top_k, key)
+        tok = _sample1(logits.astype(jnp.float32), temp, top_p, top_k, key,
+                       cap=self.ec.sample_topk_cap)
         return k_pages, v_pages, tok
 
     def _decode_impl(self, params, k_pages, v_pages, last_tokens, lengths, page_tables, n_steps, key, temps, top_ps, top_ks):
@@ -490,7 +518,8 @@ class LLMEngine:
             x, (kp, vp) = jax.lax.scan(scan_fn, x, (params["layers"], kp, vp))
             x = _rms_norm(x, params["final_norm"])
             logits = jnp.einsum("bsd,dv->bv", x, params["lm_head"].astype(cfg.dtype))
-            toks = sample_batch(logits.astype(jnp.float32), temps, top_ps, top_ks, step_key)
+            toks = sample_batch(logits.astype(jnp.float32), temps, top_ps, top_ks,
+                                step_key, cap=self.ec.sample_topk_cap)
             return (kp, vp, toks, lens + 1), toks
 
         keys = jax.random.split(key, n_steps)
@@ -540,7 +569,8 @@ class LLMEngine:
         x = _rms_norm(x, params["final_norm"])
         last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
         logits = last @ params["lm_head"].astype(cfg.dtype)
-        tok = _sample1(logits.astype(jnp.float32), temp, top_p, top_k, key)
+        tok = _sample1(logits.astype(jnp.float32), temp, top_p, top_k, key,
+                       cap=self.ec.sample_topk_cap)
         return cache_k, cache_v, tok
 
     def _decode_impl_dense(self, params, cache_k, cache_v, last_tokens, lengths, n_steps, key, temps, top_ps, top_ks):
@@ -560,7 +590,8 @@ class LLMEngine:
             x, (ck, cv) = jax.lax.scan(scan_fn, x, (params["layers"], ck, cv))
             x = _rms_norm(x, params["final_norm"])
             logits = jnp.einsum("bsd,dv->bv", x, params["lm_head"].astype(cfg.dtype))
-            toks = sample_batch(logits.astype(jnp.float32), temps, top_ps, top_ks, step_key)
+            toks = sample_batch(logits.astype(jnp.float32), temps, top_ps, top_ks,
+                                step_key, cap=self.ec.sample_topk_cap)
             return (ck, cv, toks, lens + 1), toks
 
         keys = jax.random.split(key, n_steps)
@@ -568,6 +599,97 @@ class LLMEngine:
             one_step, (cache_k, cache_v, last_tokens, lengths), keys
         )
         return cache_k, cache_v, toks, last, lengths
+
+    def _tail_prefill_impl(self, params, k_pages, v_pages, tokens, start, length,
+                           ctx_pages, tail_pages, key, temp, top_p, top_k):
+        """Chunked prefill over a cached prefix (partial-prefix KV reuse):
+        the prompt's first `start` tokens (page-aligned) already sit in this
+        request's pages, copied from the prefix cache; only the tail is
+        embedded and projected here. Tail K/V scatter into the request's
+        remaining pages; queries attend to the cached context pages
+        (gathered from the pool) plus causally to the tail itself, so the
+        sampled first token is bit-identical to a cold full prefill while
+        prefill compute scales with the tail length.
+
+        tokens: [Tb] padded tail; start/length: scalars (start page-aligned);
+        ctx_pages: [C] context page ids (trailing 0 = dead, masked by
+        position < start); tail_pages: [Tb//ps] (trailing 0 = dead sink)."""
+        cfg = self.cfg
+        ps = self.ec.page_size
+        Tb = tokens.shape[0]
+        C = ctx_pages.shape[0]
+        n_tail_pg = Tb // ps
+        KV, Hd = cfg.kv_heads, cfg.head_dim
+        group = cfg.n_heads // KV
+        x = params["embed"].astype(cfg.dtype)[tokens][None]  # [1,Tb,D]
+        tpos = jnp.arange(Tb, dtype=jnp.int32)
+        pos = (start + tpos)[None]  # [1,Tb] absolute positions
+        # Key-validity mask [Tb, C*ps + Tb]: context keys are valid iff
+        # their absolute position < start (cached region; always <= any
+        # query position); tail keys are causal within the tail and must be
+        # real (not padding past the prompt length).
+        ctx_mask = jnp.broadcast_to(
+            (jnp.arange(C * ps, dtype=jnp.int32) < start)[None, :], (Tb, C * ps)
+        )
+        tail_mask = (tpos[None, :] <= tpos[:, None]) & ((start + tpos)[None, :] < length)
+        mask = jnp.concatenate([ctx_mask, tail_mask], axis=1)
+
+        def scan_fn(h, xs):
+            lp, ck_l, cv_l = xs
+            dt = h.dtype
+            hh = _rms_norm(h, lp["attn_norm"])
+            q, k_new, v_new = _attn_proj(hh, lp, cfg, dt)
+            q = _rope(q, pos, cfg.rope_theta)
+            k_new = _rope(k_new, pos, cfg.rope_theta)
+            kt = k_new[0].transpose(1, 0, 2).astype(ck_l.dtype)  # [KV,Tb,Hd]
+            vt = v_new[0].transpose(1, 0, 2).astype(cv_l.dtype)
+
+            def write(p, pools):
+                ck, cv = pools
+                s0 = tail_pages[p] * ps
+                ck = jax.lax.dynamic_update_slice(
+                    ck, jax.lax.dynamic_slice(kt, (0, p * ps, 0), (KV, ps, Hd)), (0, s0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, jax.lax.dynamic_slice(vt, (0, p * ps, 0), (KV, ps, Hd)), (0, s0, 0))
+                return ck, cv
+
+            ck_l, cv_l = jax.lax.fori_loop(0, n_tail_pg, write, (ck_l, cv_l))
+            # Gather the cached context from the pool (unrolled — C is
+            # small and static; see _copy_pages_impl for why not a loop).
+            ctx_k = jnp.concatenate(
+                [jax.lax.dynamic_slice(ck_l, (0, ctx_pages[c] * ps, 0), (KV, ps, Hd))
+                 for c in range(C)], axis=1)
+            ctx_v = jnp.concatenate(
+                [jax.lax.dynamic_slice(cv_l, (0, ctx_pages[c] * ps, 0), (KV, ps, Hd))
+                 for c in range(C)], axis=1)
+            kall = jnp.concatenate([ctx_k, kt], axis=1)  # [KV, C*ps+Tb, Hd]
+            vall = jnp.concatenate([ctx_v, vt], axis=1)
+            qg = q[0].reshape(Tb, KV, group, Hd)
+            scores = jnp.einsum("tkgh,ksh->tkgs", qg, kall).astype(jnp.float32)
+            scores = scores / math.sqrt(Hd)
+            scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+            pr = jax.nn.softmax(scores, axis=-1).astype(dt)
+            o = jnp.einsum("tkgs,ksh->tkgh", pr, vall).reshape(1, Tb, cfg.n_heads, Hd)
+            h = h + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
+            hh = _rms_norm(h, lp["ffn_norm"])
+            h = h + _dense_ffn(hh, lp)
+            return h, (ck_l, cv_l)
+
+        x, (k_pages, v_pages) = jax.lax.scan(scan_fn, x, (params["layers"], k_pages, v_pages))
+        x = _rms_norm(x, params["final_norm"])
+        last = jax.lax.dynamic_index_in_dim(x[0], length - 1 - start, axis=0, keepdims=False)
+        logits = last @ params["lm_head"].astype(cfg.dtype)
+        toks = sample_batch(logits.astype(jnp.float32)[None], temp, top_p, top_k, key,
+                            cap=self.ec.sample_topk_cap)
+        return k_pages, v_pages, toks  # toks: [1]
+
+    def _tail_prefill(self, tail_bucket: int, n_ctx: int):
+        fn = self._tail_jit.get((tail_bucket, n_ctx))
+        if fn is None:
+            fn = self._tail_jit[(tail_bucket, n_ctx)] = jax.jit(
+                self._tail_prefill_impl, donate_argnums=(1, 2)
+            )
+        return fn
 
     def _prefill(self, bucket: int, k: int):
         fn = self._prefill_jit.get((bucket, k))
@@ -677,6 +799,58 @@ class LLMEngine:
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
 
+    def _prefix_digests(self, tokens) -> list:
+        """(covered_len, digest) pairs for every page-aligned prefix of the
+        prompt plus the full prompt — one incremental sha1 pass. Ascending;
+        lookups probe in reverse (longest first)."""
+        import hashlib
+
+        ps = self.ec.page_size
+        buf = np.ascontiguousarray(tokens, dtype=np.int32)
+        h = hashlib.sha1()
+        out = []
+        j = 0
+        while (j + 1) * ps <= len(buf):
+            h.update(buf[j * ps:(j + 1) * ps].tobytes())
+            j += 1
+            out.append((j * ps, h.copy().digest()))
+        if len(buf) % ps:
+            h.update(buf[j * ps:].tobytes())
+            out.append((len(buf), h.digest()))
+        return out
+
+    def _cache_insert(self, slot: _Slot) -> set:
+        """Move this retired slot's prompt pages into the prefix cache: one
+        entry per page-aligned prefix plus the full prompt (chained
+        digests), sharing + refcounting the pages. When a shorter prefix is
+        ALREADY cached (the common partial-hit retire), new longer entries
+        reference the existing entry's pages for the shared region — the
+        slot's own byte-identical copies of those pages are freed, so N
+        requests extending one system prompt do not hold N copies of it.
+        Returns the slot pages the cache now owns; the caller frees the
+        rest."""
+        ps = self.ec.page_size
+        slot_pages = set(slot.pages)
+        used: set = set()
+        base: tuple = ()  # longest already-cached page run for this prefix
+        for n, dg in self._prefix_digests(slot.prompt_tokens):
+            n_pg = -(-n // ps)
+            if n_pg > len(slot.pages):
+                break
+            existing = self._prefix_cache.get(dg)
+            if existing is not None:
+                self._prefix_cache.move_to_end(dg)
+                if len(existing["pages"]) >= len(base):
+                    base = tuple(existing["pages"])
+                continue
+            pages = base + tuple(slot.pages[len(base):n_pg])
+            self._prefix_cache[dg] = {"pages": pages, "prompt_len": n}
+            for p in pages:
+                self._page_refs[p] = self._page_refs.get(p, 0) + 1
+            used.update(pages)
+            base = pages
+        return used & slot_pages
+
     def _retire(self, i: int) -> None:
         """Free slot i's pages and zero its table row (dead slots must write
         only into page 0 while they keep decoding inside a block). With the
@@ -684,38 +858,41 @@ class LLMEngine:
         instead of the free list."""
         slot = self.slots[i]
         if slot is not None:
-            n_pp = -(-slot.prompt_len // self.ec.page_size) if self.paged else 0
-            if (
-                slot.cache_key is not None
-                and slot.cache_key not in self._prefix_cache
-                and n_pp > 0
-                and len(slot.pages) >= n_pp
-            ):
-                self._prefix_cache[slot.cache_key] = {
-                    "pages": slot.pages[:n_pp], "prompt_len": slot.prompt_len,
-                }
-                self.free_pages.extend(slot.pages[n_pp:])
-            else:
-                self.free_pages.extend(slot.pages)
+            kept: set = set()
+            if slot.prompt_tokens is not None and self.paged:
+                kept = self._cache_insert(slot)
+            self.free_pages.extend(p for p in slot.pages if p not in kept)
         self.slots[i] = None
         self.lengths[i] = 0
         self.page_tables[i, :] = 0
 
-    def _evict_prefix_cache(self, need_pages: int) -> None:
-        """LRU-evict cache entries until need_pages are back in the free
-        list (admission pressure beats cached prefixes)."""
-        while need_pages > 0 and self._prefix_cache:
-            _, entry = self._prefix_cache.popitem(last=False)
-            self.free_pages.extend(entry["pages"])
-            need_pages -= len(entry["pages"])
+    def _evict_prefix_cache(self, need_pages: int, protect: frozenset = frozenset()) -> None:
+        """LRU-evict cache entries until need_pages pages are back in the
+        free list (admission pressure beats cached prefixes). A page shared
+        by several chain entries frees only when its LAST referencing entry
+        goes. `protect` exempts the entry the current admission is about to
+        hit — evict-before-lookup used to let a request evict its own
+        cached prefix to fund its allocation."""
+        while need_pages > 0:
+            victim = next((k for k in self._prefix_cache if k not in protect), None)
+            if victim is None:
+                return
+            entry = self._prefix_cache.pop(victim)
+            for p in entry["pages"]:
+                self._page_refs[p] -= 1
+                if not self._page_refs[p]:
+                    del self._page_refs[p]
+                    self.free_pages.append(p)
+                    need_pages -= 1
 
     @property
     def prefix_cache_stats(self) -> dict:
         return {
             "hits": self.prefix_hits,
+            "partial_hits": self.prefix_partial_hits,
             "misses": self.prefix_misses,
             "entries": len(self._prefix_cache),
-            "cached_pages": sum(len(e["pages"]) for e in self._prefix_cache.values()),
+            "cached_pages": len(self._page_refs),  # distinct pages held
         }
 
     def step(self) -> dict:
@@ -730,32 +907,50 @@ class LLMEngine:
         # 1. admit: page-budgeted assignment of waiting requests to free slots.
         admitted: list[tuple[int, str, np.ndarray, int, int, float]] = []
         cache_hits: list[tuple[int, int]] = []  # (slot, last prompt token)
+        tail_admitted: list[tuple[int, str, np.ndarray, int, int, float]] = []
         use_cache = self.paged and self.ec.prefix_cache
         for i in range(self.ec.max_slots):
             if not self.waiting or self.slots[i] is not None:
                 continue
             req_id, tokens, sp, arrived = self.waiting[0]
-            need = self._pages_needed(len(tokens), sp.max_tokens)
+            P = len(tokens)
+            need = self._pages_needed(P, sp.max_tokens)
+            # Cache lookup BEFORE eviction: longest match first — the full
+            # prompt (exact hit, no prefill at all), then page-aligned
+            # prefixes descending (partial hit, tail prefill only).
+            hit_dg = hit_entry = None
+            hit_len = 0
+            if use_cache:
+                for n, dg in reversed(self._prefix_digests(tokens)):
+                    e = self._prefix_cache.get(dg)
+                    if e is not None and e["prompt_len"] == n and (n == P or n % ps == 0):
+                        hit_dg, hit_entry, hit_len = dg, e, n
+                        break
             if need > len(self.free_pages):
-                self._evict_prefix_cache(need - len(self.free_pages))
+                self._evict_prefix_cache(
+                    need - len(self.free_pages),
+                    protect=frozenset((hit_dg,)) if hit_dg is not None else frozenset(),
+                )
             if need > len(self.free_pages):
-                break  # head-of-line blocks until pages free (FIFO fairness)
+                # Protected-entry corner: if nothing is running (no retire
+                # will ever free pages) and the only reclaimable pages are
+                # the would-be hit's own, degrade to a miss rather than
+                # livelock the queue.
+                if hit_dg is not None and not any(s is not None for s in self.slots):
+                    hit_dg = hit_entry = None
+                    self._evict_prefix_cache(need - len(self.free_pages))
+                if need > len(self.free_pages):
+                    break  # head-of-line blocks until pages free (FIFO fairness)
             self.waiting.popleft()
             pages = [self.free_pages.popleft() for _ in range(need)]
-            P = len(tokens)
-            key = hit = None
-            if use_cache:
-                import hashlib as _hl
-
-                key = _hl.sha1(np.ascontiguousarray(tokens).tobytes()).digest()
-                hit = self._prefix_cache.get(key)
-                if hit is not None and hit["prompt_len"] != P:
-                    hit = None
+            exact = hit_entry is not None and hit_len == P
             self.slots[i] = _Slot(
                 req_id=req_id, max_tokens=sp.max_tokens, pages=pages,
-                n_generated=1 if hit is None else 0, arrived_at=arrived,
+                n_generated=0 if exact else 1, arrived_at=arrived,
                 stop_ids=tuple(sp.stop_token_ids), ignore_eos=sp.ignore_eos,
-                cache_key=key if (use_cache and hit is None) else None,
+                prompt_tokens=(
+                    np.asarray(tokens, np.int32) if (use_cache and not exact) else None
+                ),
                 prompt_len=P,
             )
             self.samp_temps[i] = sp.temperature
@@ -764,25 +959,34 @@ class LLMEngine:
             row = np.zeros(self.ppseq, np.int32)
             row[: len(pages)] = pages
             self.page_tables[i] = row
-            if hit is not None:
-                # Exact-prefix hit: copy cached prompt pages, decode from
-                # position P-1 (the block re-derives that position's KV and
-                # emits the first token — no prefill). The copy happens
-                # INLINE, before the next admission can LRU-evict this entry
-                # and recycle its pages (same-step evict-after-claim would
-                # otherwise read pages already back on the free list).
-                self.prefix_hits += 1
-                self._prefix_cache.move_to_end(key)
-                self.lengths[i] = P - 1
-                n_pp = len(hit["pages"])
+            if hit_entry is not None:
+                # Copy the matched pages into this request's own pages. The
+                # copy happens INLINE, before the next admission can
+                # LRU-evict this entry and recycle its pages (same-step
+                # evict-after-claim would otherwise read pages already back
+                # on the free list).
+                self._prefix_cache.move_to_end(hit_dg)
+                n_pp = len(hit_entry["pages"])
                 src = np.zeros(self.ppseq, np.int32)
-                src[:n_pp] = hit["pages"]
+                src[:n_pp] = hit_entry["pages"]
                 dst = np.zeros(self.ppseq, np.int32)
                 dst[:n_pp] = pages[:n_pp]
                 self.k_pages, self.v_pages = self._copy_pages_jit(
                     self.k_pages, self.v_pages, jnp.asarray(src), jnp.asarray(dst)
                 )
-                cache_hits.append((i, int(tokens[-1])))
+                if exact:
+                    # Decode from position P-1: the block re-derives that
+                    # position's KV (identical bytes) and emits the first
+                    # token — no prefill.
+                    self.prefix_hits += 1
+                    self.lengths[i] = P - 1
+                    cache_hits.append((i, int(tokens[-1])))
+                else:
+                    # Partial hit: prefill only the tail over the cached
+                    # context (dispatched with the prefill groups below).
+                    self.prefix_partial_hits += 1
+                    self.lengths[i] = P
+                    tail_admitted.append((i, req_id, tokens, hit_len, sp.max_tokens, arrived))
             else:
                 if use_cache:
                     self.prefix_misses += 1
@@ -831,7 +1035,36 @@ class LLMEngine:
                 self.d_lengths = self.d_lengths.at[idx_arr].set(jnp.asarray(lens))
                 self.d_last = self.d_last.at[idx_arr].set(toks_dev)
                 dispatched.append((chunk, toks_dev))
-        if admitted or cache_hits:
+        # Partial-prefix hits: per-request tail prefill over the cached
+        # context pages (tail + ctx sizes snap to buckets; one compiled
+        # program per (tail_bucket, ctx_bucket)).
+        for (i, req_id, tokens, start, _mt, arrived) in tail_admitted:
+            P = len(tokens)
+            tail = tokens[start:]
+            tb = next(b for b in self.buckets if b >= len(tail))
+            j = start // ps
+            C = next(c for c in self.c_buckets if c >= j)
+            padded = np.zeros(tb, np.int32)
+            padded[: len(tail)] = tail
+            ctx = np.zeros(C, np.int32)
+            ctx[:j] = self.page_tables[i, :j]
+            n_tpg = tb // ps
+            tpg = np.zeros(n_tpg, np.int32)
+            m = min(n_tpg, self.ppseq - j)
+            tpg[:m] = self.page_tables[i, j:j + m]  # zeros past need -> dead sink
+            self._key, sub = jax.random.split(self._key)
+            self.k_pages, self.v_pages, toks_dev = self._tail_prefill(tb, C)(
+                self.params, self.k_pages, self.v_pages,
+                jnp.asarray(padded), jnp.int32(start), jnp.int32(P),
+                jnp.asarray(ctx), jnp.asarray(tpg), sub,
+                jnp.asarray(self.samp_temps[i:i + 1]),
+                jnp.asarray(self.samp_top_ps[i:i + 1]),
+                jnp.asarray(self.samp_top_ks[i:i + 1]),
+            )
+            self.d_lengths = self.d_lengths.at[i].set(P)
+            self.d_last = self.d_last.at[i].set(toks_dev[0])
+            dispatched.append(([(i, req_id, tokens, None, _mt, arrived)], toks_dev))
+        if admitted or cache_hits or tail_admitted:
             self.d_page_tables = jnp.asarray(self.page_tables)
             self.d_temps = jnp.asarray(self.samp_temps)
             self.d_top_ps = jnp.asarray(self.samp_top_ps)
@@ -905,6 +1138,7 @@ class LLMEngine:
                             slot = self.slots[i]
                             ev = events.setdefault(slot.req_id, {"ttft_s": None})
                             ev["finished"] = True
+                            ev["finish_reason"] = "length"  # context-cap retirement
                             ev["tokens"] = list(slot.emitted)
                             ev["ttft_s"] = ev.get("ttft_s") or (
                                 (slot.first_token_at or slot.arrived_at) - slot.arrived_at
@@ -945,15 +1179,24 @@ class LLMEngine:
 
     def _maybe_finish(self, i: int, events: dict) -> bool:
         slot = self.slots[i]
-        done = (
-            len(slot.emitted) >= slot.max_tokens
-            or (not slot.ignore_eos and self.ec.eos_id >= 0 and slot.emitted[-1] == self.ec.eos_id)
+        # Retire cause rides the event as OpenAI-style finish_reason: a
+        # token-triggered stop (eos / per-request stop ids) is "stop"; any
+        # budget cap (max_tokens, or forced retirement at the max_seq
+        # context ceiling) is "length" — previously a max_seq retirement
+        # was mislabeled "stop" by the under-max_tokens heuristic upstream.
+        stopped = (
+            (not slot.ignore_eos and self.ec.eos_id >= 0 and slot.emitted[-1] == self.ec.eos_id)
             or slot.emitted[-1] in slot.stop_ids
+        )
+        capped = (
+            len(slot.emitted) >= slot.max_tokens
             or int(self.lengths[i]) + 1 >= self.ec.max_seq
         )
+        done = stopped or capped
         if done:
             ev = events.setdefault(slot.req_id, {"ttft_s": None})
             ev["finished"] = True
+            ev["finish_reason"] = "stop" if stopped else "length"
             ev["tokens"] = list(slot.emitted)
             ev["ttft_s"] = ev.get("ttft_s") or (slot.first_token_at - slot.arrived_at)
             self._retire(i)

@@ -15,6 +15,13 @@ Per-request sampling rides SamplingParams into the engine, so one continuous
 batch mixes greedy and sampled requests. Stop STRINGS are applied here at
 the text layer (with holdback so a stop sequence split across decode blocks
 never leaks to the client); stop token ids and eos retire in the engine.
+
+DEVIATION from the OpenAI API: a request that omits `temperature` inherits
+the ENGINE's configured default (EngineConfig.temperature, 0.0 = greedy) —
+not OpenAI's 1.0. Deterministic-by-default is the safer contract for a
+self-hosted engine (evals, caching, tests); clients wanting OpenAI's
+behavior pass temperature explicitly. The deviation is advertised in
+/v1/models metadata (`default_temperature`).
 """
 from __future__ import annotations
 
@@ -115,13 +122,45 @@ class OpenAIServer:
         self.tok = load_tokenizer(tokenizer)
         self.model_name = model_name
         self.created = int(time.time())
-        # "{role}: {content}" per message + a generation prompt — the
-        # fallback template shape; pass chat_template to override
-        # ({messages} is substituted with the formatted turns).
+        # Chat prompt rendering, in precedence order (reference: vLLM's
+        # template resolution — explicit template arg, else the checkpoint's
+        # own tokenizer template):
+        # 1. `chat_template` containing jinja syntax -> rendered with
+        #    (messages, add_generation_prompt), HF template semantics.
+        # 2. no arg + an HF tokenizer that ships chat_template -> the
+        #    checkpoint's own format (what the model was tuned on).
+        # 3. legacy format string: "{messages}" substituted with
+        #    "role: content\n" turns (the dependency-free fallback).
         self.chat_template = chat_template or "{messages}assistant:"
+        self._jinja = None
+        if chat_template and ("{%" in chat_template or "{{" in chat_template):
+            import jinja2
+
+            env = jinja2.Environment(
+                trim_blocks=True, lstrip_blocks=True,
+                undefined=jinja2.StrictUndefined,
+            )
+            # The globals HF templates rely on (Llama-2 uses bos_token/
+            # eos_token; many use raise_exception for role validation).
+            inner = getattr(self.tok, "_tok", None)
+            env.globals["bos_token"] = getattr(inner, "bos_token", None) or ""
+            env.globals["eos_token"] = getattr(inner, "eos_token", None) or ""
+
+            def _raise(msg):
+                raise ValueError(f"chat template error: {msg}")
+
+            env.globals["raise_exception"] = _raise
+            self._jinja = env.from_string(chat_template)
+        self._use_tok_template = (
+            chat_template is None
+            and getattr(self.tok, "chat_template", None) is not None
+        )
         ec = dict(engine_config or {})
         if "eos_id" not in ec and self.tok.eos_id >= 0:
             ec["eos_id"] = self.tok.eos_id
+        # Requests that omit temperature inherit the engine default (see
+        # module docstring: deliberate deviation from OpenAI's 1.0).
+        self.default_temperature = float(ec.get("temperature", 0.0))
         self._llm = LLMServer(model_config, ec, warmup_buckets=warmup_buckets)
 
     # -- request plumbing --------------------------------------------------
@@ -134,16 +173,32 @@ class OpenAIServer:
 
     def _sampling(self, body: dict) -> SamplingParams:
         return SamplingParams(
-            temperature=float(body.get("temperature", 0.0)),
+            temperature=float(body.get("temperature", self.default_temperature)),
             top_p=float(body.get("top_p", 1.0)),
             top_k=int(body.get("top_k", 0)),
             max_tokens=int(body.get("max_tokens", 128)),
             ignore_eos=bool(body.get("ignore_eos", False)),
         )
 
-    def _chat_prompt(self, messages) -> str:
+    def _chat_prompt(self, messages) -> tuple[str, bool]:
+        """Returns (prompt, templated): templated prompts already carry
+        their own special tokens (BOS etc.), so encode must NOT add BOS
+        again — most HF templates open with the bos text and a second
+        bos_id would push the prompt off the model's trained distribution."""
+        if self._jinja is not None:
+            import jinja2
+
+            try:
+                return (
+                    self._jinja.render(messages=messages, add_generation_prompt=True),
+                    True,
+                )
+            except jinja2.TemplateError as e:  # surfaces as a 400, not a 500
+                raise ValueError(f"chat template error: {e}") from e
+        if self._use_tok_template:
+            return self.tok.apply_chat_template(messages, add_generation_prompt=True), True
         turns = "".join(f"{m.get('role', 'user')}: {m.get('content', '')}\n" for m in messages)
-        return self.chat_template.format(messages=turns)
+        return self.chat_template.format(messages=turns), False
 
     def __call__(self, request):
         if isinstance(request, dict):
@@ -158,7 +213,10 @@ class OpenAIServer:
             return {
                 "object": "list",
                 "data": [{"id": self.model_name, "object": "model",
-                          "created": self.created, "owned_by": "ray_tpu"}],
+                          "created": self.created, "owned_by": "ray_tpu",
+                          # Deviation note: omitted temperature => this
+                          # value, not OpenAI's 1.0 (module docstring).
+                          "default_temperature": self.default_temperature}],
             }
         is_chat = path.rstrip("/") == "/v1/chat/completions"
         if not is_chat and path.rstrip("/") != "/v1/completions":
@@ -169,9 +227,10 @@ class OpenAIServer:
             body = request.json() if not isinstance(request, dict) else request
             if not isinstance(body, dict):
                 raise ValueError("body must be a JSON object")
+            templated = False
             if is_chat:
                 messages = body["messages"]
-                prompt = self._chat_prompt(messages)
+                prompt, templated = self._chat_prompt(messages)
             else:
                 prompt = body["prompt"]
                 if not isinstance(prompt, str):
@@ -180,7 +239,8 @@ class OpenAIServer:
             stops = _as_tuple(body.get("stop"))
         except (KeyError, ValueError, TypeError) as e:
             return self._error(400, str(e))
-        prompt_ids = self.tok.encode(prompt, add_bos=True)
+        # Templated prompts already contain their special tokens.
+        prompt_ids = self.tok.encode(prompt, add_bos=not templated)
         rid = f"{'chatcmpl' if is_chat else 'cmpl'}-{time.monotonic_ns():x}"
         if body.get("stream"):
             return self._stream(rid, is_chat, prompt_ids, sp, stops)
@@ -191,7 +251,10 @@ class OpenAIServer:
         out = self._llm.generate(prompt_ids, sampling=sp)
         trunc = _StopTruncator(self.tok, stops)
         text = trunc.feed(out["tokens"]) + trunc.flush()
-        finish = "stop" if (trunc.stopped or len(out["tokens"]) < sp.max_tokens) else "length"
+        # Engine's retire cause ("stop" = eos/stop-token, "length" =
+        # max_tokens OR the max_seq context cap); a text-layer stop string
+        # overrides to "stop".
+        finish = "stop" if trunc.stopped else (out.get("finish_reason") or "stop")
         usage = {
             "prompt_tokens": n_prompt,
             "completion_tokens": len(out["tokens"]),
@@ -233,20 +296,21 @@ class OpenAIServer:
     def _stream(self, rid, is_chat, prompt_ids, sp, stops):
         trunc = _StopTruncator(self.tok, stops)
         first = True
-        n_out = 0
+        engine_finish = None
         for ev in self._llm.generate_stream(prompt_ids, sampling=sp):
-            n_out += len(ev.get("new_tokens", ()))
             delta = trunc.feed(ev.get("new_tokens", ()))
             if delta or first:
                 yield self._chunk(rid, is_chat, delta, first=first)
                 first = False
+            if ev.get("finished"):
+                engine_finish = ev.get("finish_reason")
             if trunc.stopped or ev.get("finished"):
                 break
         tail = trunc.flush()
         if tail:
             yield self._chunk(rid, is_chat, tail, first=first)
             first = False
-        finish = "stop" if (trunc.stopped or n_out < sp.max_tokens) else "length"
+        finish = "stop" if trunc.stopped else (engine_finish or "stop")
         yield self._chunk(rid, is_chat, "", finish=finish, first=first)
         yield "data: [DONE]\n\n"
 
@@ -261,13 +325,7 @@ class OpenAIServer:
         self._llm.__raytpu_exit__()
 
 
-def openai_prefix_router(request) -> str:
-    """Proxy-side router policy: requests sharing a prompt/messages PREFIX
-    map to one affinity key, so they stick to the replica whose engine holds
-    those KV pages (pair with EngineConfig.prefix_cache=True). Reference:
-    PrefixCacheAffinityRouter, prefix_aware_router.py:39."""
-    import hashlib
-
+def _request_prefix_text(request) -> str:
     try:
         body = request.json()
     except Exception:
@@ -275,16 +333,57 @@ def openai_prefix_router(request) -> str:
     if not isinstance(body, dict):
         return ""
     if "messages" in body:
-        text = "".join(
+        return "".join(
             f"{m.get('role', '')}:{m.get('content', '')}\n"
             for m in body["messages"][:4]
             if isinstance(m, dict)
         )
-    else:
-        text = body.get("prompt", "")
-    if not isinstance(text, str) or not text:
-        return ""
-    return hashlib.sha1(text[:256].encode()).hexdigest()[:16]
+    text = body.get("prompt", "")
+    return text if isinstance(text, str) else ""
+
+
+def make_prefix_router(tokenizer=None, page_size: int = 128):
+    """Build a proxy-side router policy keyed on the request's FIRST KV
+    PAGE: requests sharing a page-aligned token prefix map to one affinity
+    key, so they stick to the replica whose engine caches those pages
+    (reference: PrefixCacheAffinityRouter, prefix_aware_router.py:39).
+
+    Sharing the first full page is a necessary condition for ANY prefix-
+    cache hit (the cache is page-granular), so the first page IS the right
+    affinity key: finer keys split cache-compatible requests across
+    replicas, coarser ones collapse unrelated prompts onto one.
+
+    With a tokenizer the key is the digest of tokens[:page_size], exactly
+    the engine's first chain digest. Without one, a char-space proxy is
+    used (~4 chars/token). Prompts too short to fill a page can never hit
+    the page cache, so they hash whole — spreading them is free."""
+    import hashlib
+
+    tok = None
+    if tokenizer is not None:
+        from ray_tpu.llm.tokenizer import load_tokenizer
+
+        tok = load_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
+
+    def policy(request) -> str:
+        text = _request_prefix_text(request)
+        if not text:
+            return ""
+        if tok is not None:
+            # Bound BPE cost on the routing hot path: only the first page of
+            # tokens matters, and ~6 chars/token over-covers any tokenizer.
+            ids = tok.encode(text[: page_size * 6], add_bos=True)
+            head = ids[:page_size]
+        else:
+            head = text[: page_size * 4]
+        return hashlib.sha1(repr(head).encode()).hexdigest()[:16]
+
+    return policy
+
+
+# Default instance (no tokenizer: char-space page proxy at the default
+# page_size of 128 tokens ~ 512 chars).
+openai_prefix_router = make_prefix_router()
 
 
 def build_openai_app(model_config: dict, engine_config: Optional[dict] = None,
@@ -292,7 +391,8 @@ def build_openai_app(model_config: dict, engine_config: Optional[dict] = None,
                      num_replicas: int = 1, max_ongoing_requests: Optional[int] = None,
                      warmup_buckets: Optional[tuple] = None,
                      ray_actor_options: Optional[dict] = None,
-                     prefix_routing: bool = False):
+                     prefix_routing: bool = False,
+                     chat_template: Optional[str] = None):
     """OpenAI-compatible serving app; serve.run(...) it with a route_prefix
     and POST /v1/chat/completions to the proxy port. prefix_routing=True
     installs the prefix-affinity router policy in the proxy (pair with
@@ -301,13 +401,19 @@ def build_openai_app(model_config: dict, engine_config: Optional[dict] = None,
     from ray_tpu import serve
     from ray_tpu.llm.engine import EngineConfig
 
-    slots = EngineConfig(**{k: v for k, v in (engine_config or {}).items()
-                            if k in EngineConfig.__dataclass_fields__}).max_slots
+    ec = EngineConfig(**{k: v for k, v in (engine_config or {}).items()
+                         if k in EngineConfig.__dataclass_fields__})
     dep = serve.deployment(OpenAIServer).options(
         name="openai_llm",
         num_replicas=num_replicas,
-        max_ongoing_requests=max_ongoing_requests or slots,
+        max_ongoing_requests=max_ongoing_requests or ec.max_slots,
         ray_actor_options=ray_actor_options or {},
-        request_router=openai_prefix_router if prefix_routing else None,
+        # Router keys match the engine's page-granular cache: same
+        # tokenizer, same page size -> the affinity key IS the engine's
+        # first chain digest boundary.
+        request_router=(
+            make_prefix_router(tokenizer, ec.page_size) if prefix_routing else None
+        ),
     )
-    return dep.bind(model_config, engine_config, tokenizer, model_name, warmup_buckets)
+    return dep.bind(model_config, engine_config, tokenizer, model_name,
+                    warmup_buckets, chat_template)

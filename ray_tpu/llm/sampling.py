@@ -18,7 +18,10 @@ import jax.numpy as jnp
 # Static candidate cap for truncated (top-k / top-p) rows: XLA needs a fixed
 # shape, and a 128-candidate top_k covers every practical top_k and the
 # nucleus mass of peaked LM distributions. Rows with top_p>=1 & top_k off
-# bypass it and sample the full distribution exactly.
+# bypass it and sample the full distribution exactly. CAVEAT: a high-entropy
+# distribution with top_p just below 1 has a nucleus wider than the cap; the
+# sampled distribution is then the renormalized top-`cap`, not the true
+# nucleus — raise EngineConfig.sample_topk_cap when that matters.
 TOPK_CAP = 128
 
 
@@ -54,17 +57,18 @@ class SamplingParams:
             raise ValueError(f"max_tokens must be > 0, got {self.max_tokens}")
 
 
-def sample_batch(logits, temps, top_ps, top_ks, key):
+def sample_batch(logits, temps, top_ps, top_ks, key, cap: int | None = None):
     """Sample one token per row of logits [B, V] under per-row params.
 
     Rows with temps<=0 take argmax. Truncated rows (top_k>0 or top_p<1)
-    sample among the top-TOPK_CAP candidates after top-k and nucleus
-    masking; plain-temperature rows sample the full distribution.
+    sample among the top-`cap` candidates (default TOPK_CAP=128; see its
+    caveat) after top-k and nucleus masking; plain-temperature rows sample
+    the full distribution.
     """
     V = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    cap = min(TOPK_CAP, V)
+    cap = min(TOPK_CAP if cap is None else cap, V)
     top_vals, top_idx = jax.lax.top_k(scaled, cap)  # [B, cap], descending
     ks = jnp.where(top_ks <= 0, cap, jnp.minimum(top_ks, cap))
     pos = jnp.arange(cap)[None, :]

@@ -36,6 +36,7 @@ class Tokenizer:
         # produces id _N_SPECIAL + 256 + i.
         self.merges: list[tuple[int, int]] = [tuple(m) for m in (merges or [])]
         self._ranks = {m: i for i, m in enumerate(self.merges)}
+        self._byte_cache: dict[int, bytes] = {}  # merged id -> rendered bytes
 
     # -- vocabulary --------------------------------------------------------
     @property
@@ -127,14 +128,35 @@ class Tokenizer:
         return out
 
     def _expand(self, tid: int, buf: bytearray):
+        """Render one id's bytes. Iterative with an explicit stack — a deep
+        merge chain (long repeated-byte runs make nesting ~linear in token
+        length) must not hit Python's recursion limit — and memoized per
+        merged id, so decode cost is amortized O(bytes)."""
         if tid < _N_SPECIAL:
             return  # specials render as nothing
         if tid < _N_SPECIAL + 256:
             buf.append(tid - _N_SPECIAL)
             return
-        left, right = self.merges[tid - _N_SPECIAL - 256]
-        self._expand(left, buf)
-        self._expand(right, buf)
+        cached = self._byte_cache.get(tid)
+        if cached is None:
+            out = bytearray()
+            stack = [tid]
+            while stack:
+                t = stack.pop()
+                if t < _N_SPECIAL:
+                    continue
+                if t < _N_SPECIAL + 256:
+                    out.append(t - _N_SPECIAL)
+                    continue
+                hit = self._byte_cache.get(t)
+                if hit is not None:
+                    out.extend(hit)
+                    continue
+                left, right = self.merges[t - _N_SPECIAL - 256]
+                stack.append(right)
+                stack.append(left)
+            cached = self._byte_cache[tid] = bytes(out)
+        buf.extend(cached)
 
     def decode(self, ids: Iterable[int]) -> str:
         buf = bytearray()
@@ -194,6 +216,19 @@ class HFTokenizer:
 
     def decode(self, ids) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    @property
+    def chat_template(self) -> Optional[str]:
+        """The checkpoint's own chat template (jinja source), if it ships
+        one — instruction-tuned HF checkpoints do; the ingress uses it so
+        /v1/chat/completions renders the prompt format the model was tuned
+        on (reference: vLLM resolves the template from the HF tokenizer)."""
+        return getattr(self._tok, "chat_template", None)
+
+    def apply_chat_template(self, messages, add_generation_prompt: bool = True) -> str:
+        return self._tok.apply_chat_template(
+            list(messages), tokenize=False, add_generation_prompt=add_generation_prompt
+        )
 
 
 def load_tokenizer(spec: Optional[str]) -> Tokenizer | HFTokenizer:

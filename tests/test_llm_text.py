@@ -60,6 +60,19 @@ def test_tokenizer_specials():
     assert tok.decode(ids) == "hi"  # specials render as nothing
 
 
+def test_tokenizer_deep_merge_chain_decodes():
+    """A degenerate corpus can learn a merge chain nested deeper than
+    Python's recursion limit; decode must expand iteratively."""
+    import sys
+
+    base = 3 + ord("a")  # byte token for 'a'
+    depth = sys.getrecursionlimit() + 500
+    merges = [(base, base)] + [(3 + 256 + i, base) for i in range(depth - 1)]
+    tok = Tokenizer(merges)
+    deepest = 3 + 256 + len(merges) - 1
+    assert tok.decode([deepest]) == "a" * (depth + 1)
+
+
 # ---------------------------------------------------------------------------
 # per-request sampling
 # ---------------------------------------------------------------------------
@@ -121,6 +134,22 @@ def test_stop_token_ids(engine):
     assert got == solo[:4]  # stops AT the stop token (inclusive emission)
 
 
+def test_finish_reason_length_at_context_cap(engine):
+    """A request force-retired at the max_seq context ceiling reports
+    finish_reason 'length' even though fewer than max_tokens were generated
+    (previously mislabeled 'stop' by the under-max_tokens heuristic)."""
+    prompt = [3 + (i % 200) for i in range(120)]  # 120 of 128 context
+    engine.add_request("ctxcap", prompt, sampling=SamplingParams(max_tokens=64))
+    reasons = {}
+    while engine.has_work():
+        for rid, ev in engine.step().items():
+            if ev.get("finished"):
+                reasons[rid] = (ev.get("finish_reason"), len(ev["tokens"]))
+    reason, n = reasons["ctxcap"]
+    assert n < 64, "context cap should have cut generation short"
+    assert reason == "length", reasons
+
+
 def test_top_p_restricts_support(engine):
     """top_p≈0 keeps only the most probable token -> equals greedy."""
     prompt = np.array([2, 7, 1, 8], np.int32)
@@ -166,6 +195,86 @@ def _http(port, method, path, payload=None, timeout=120):
             rest = rest[size + 2:]
         return status, headers, body_out
     return status, headers, rest
+
+
+TINY_MODEL = dict(
+    vocab_size=512, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+    d_ff=64, max_seq_len=64, attention_impl="reference",
+)
+TINY_ENGINE = {"max_slots": 2, "max_seq": 64, "prefill_buckets": (16,)}
+
+CHATML = (
+    "{% for message in messages %}"
+    "<|im_start|>{{ message.role }}\n{{ message.content }}<|im_end|>\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|im_start|>assistant\n{% endif %}"
+)
+
+CONVERSATION = [
+    {"role": "system", "content": "You are a helpful assistant."},
+    {"role": "user", "content": "What is a TPU?"},
+    {"role": "assistant", "content": "A matrix-multiply accelerator."},
+    {"role": "user", "content": "Thanks!"},
+]
+
+
+def test_chat_template_jinja_golden():
+    """A jinja chat template renders a multi-turn conversation into the
+    exact prompt format the checkpoint expects (golden: ChatML, the format
+    Qwen-family checkpoints are tuned on)."""
+    from ray_tpu.llm.openai import OpenAIServer
+
+    srv = OpenAIServer(TINY_MODEL, TINY_ENGINE, chat_template=CHATML)
+    got, templated = srv._chat_prompt(CONVERSATION)
+    assert templated  # rendered prompts must not get a second BOS
+    assert got == (
+        "<|im_start|>system\nYou are a helpful assistant.<|im_end|>\n"
+        "<|im_start|>user\nWhat is a TPU?<|im_end|>\n"
+        "<|im_start|>assistant\nA matrix-multiply accelerator.<|im_end|>\n"
+        "<|im_start|>user\nThanks!<|im_end|>\n"
+        "<|im_start|>assistant\n"
+    )
+    srv.__raytpu_exit__()
+
+
+def test_chat_template_tokenizer_precedence(monkeypatch):
+    """No explicit template + a tokenizer that ships one (HF checkpoints
+    do) -> the checkpoint's own template is used; an explicit template
+    still wins; without either, the legacy role:content fallback."""
+    import ray_tpu.llm.openai as oai
+
+    class TokWithTemplate:
+        eos_id, bos_id, vocab_size = 2, 1, 512
+        chat_template = "non-none"
+
+        def encode(self, text, add_bos=False, add_eos=False):
+            return [1, 3, 4]
+
+        def decode(self, ids):
+            return "x"
+
+        def apply_chat_template(self, messages, add_generation_prompt=True):
+            return "|".join(m["role"] for m in messages) + (
+                "|gen" if add_generation_prompt else "")
+
+    monkeypatch.setattr(oai, "load_tokenizer", lambda spec: TokWithTemplate())
+    srv = oai.OpenAIServer(TINY_MODEL, TINY_ENGINE)
+    assert srv._chat_prompt(CONVERSATION) == ("system|user|assistant|user|gen", True)
+    srv.__raytpu_exit__()
+    # Explicit jinja template beats the tokenizer's.
+    srv2 = oai.OpenAIServer(TINY_MODEL, TINY_ENGINE, chat_template=CHATML)
+    assert srv2._chat_prompt([{"role": "user", "content": "q"}])[0].startswith(
+        "<|im_start|>user")
+    srv2.__raytpu_exit__()
+
+
+def test_chat_template_legacy_fallback():
+    from ray_tpu.llm.openai import OpenAIServer
+
+    srv = OpenAIServer(TINY_MODEL, TINY_ENGINE)  # byte tokenizer: no template
+    got, templated = srv._chat_prompt([{"role": "user", "content": "hi"}])
+    assert got == "user: hi\nassistant:" and not templated
+    srv.__raytpu_exit__()
 
 
 def test_openai_ingress_end_to_end():

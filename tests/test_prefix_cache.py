@@ -29,8 +29,10 @@ def test_hit_is_exact_and_skips_prefill():
     prompt = np.arange(1, 70, dtype=np.int32) % 97
 
     cold = eng.generate(prompt, max_tokens=8)
-    assert eng.prefix_cache_stats == {"hits": 0, "misses": 1, "entries": 1,
-                                      "cached_pages": 2}
+    # 69 tokens @ page 64: chain entry for the 64-token page-aligned prefix
+    # + the full-prompt entry, sharing page 0 -> 2 distinct cached pages.
+    assert eng.prefix_cache_stats == {"hits": 0, "partial_hits": 0, "misses": 1,
+                                      "entries": 2, "cached_pages": 2}
     calls = []
     orig = eng._prefill
 
@@ -94,6 +96,158 @@ def test_cold_warm_ttft_gap():
     assert warm < cold, f"cache-hit ttft {warm:.4f}s not below cold {cold:.4f}s"
 
 
+def _count_prefills(eng):
+    calls = []
+    orig_full, orig_tail = eng._prefill, eng._tail_prefill
+
+    def full(bucket, k):
+        calls.append(("full", bucket, k))
+        return orig_full(bucket, k)
+
+    def tail(tb, c):
+        calls.append(("tail", tb, c))
+        return orig_tail(tb, c)
+
+    eng._prefill, eng._tail_prefill = full, tail
+    return calls
+
+
+def test_partial_prefix_tail_prefill_matches_cold():
+    """The canonical shared-system-prompt workload: a prompt EXTENDING a
+    cached page-aligned prefix prefills only the tail, attending over the
+    cached pages — greedy output is identical to a cold engine's, and no
+    full-length prefill is dispatched."""
+    sys_prompt = (np.arange(7, 7 + 128, dtype=np.int32) % 96) + 1  # 2 pages
+    q1 = np.concatenate([sys_prompt, np.array([3, 1, 4, 1, 5], np.int32)])
+    q2 = np.concatenate([sys_prompt, np.array([2, 7, 1, 8], np.int32)])
+
+    warm_eng = _engine()
+    warm_eng.generate(q1, max_tokens=8)  # populates chain entries for sys
+    calls = _count_prefills(warm_eng)
+    warm = warm_eng.generate(q2, max_tokens=8)
+    assert warm_eng.prefix_cache_stats["partial_hits"] == 1
+    assert all(c[0] == "tail" for c in calls), f"partial hit ran full prefill: {calls}"
+    assert calls and calls[0][1] == 64, f"tail bucket should be 64: {calls}"
+
+    cold_eng = _engine()  # same seed -> same params
+    cold = cold_eng.generate(q2, max_tokens=8)
+    assert warm["tokens"] == cold["tokens"], (
+        f"partial-prefix output diverged: {warm['tokens']} vs {cold['tokens']}"
+    )
+
+
+def test_partial_prefix_page_aligned_extension():
+    """A prompt that extends the cached prefix by exactly whole pages (the
+    new length is page-aligned and fully covered by a chain entry of an
+    earlier LONGER prompt's prefix) restarts decode with no prefill."""
+    base = (np.arange(11, 11 + 200, dtype=np.int32) % 96) + 1  # 3 full pages + tail
+    eng = _engine()
+    eng.generate(base, max_tokens=4)
+    calls = _count_prefills(eng)
+    # First 128 tokens = exactly 2 cached full pages -> exact-length chain
+    # hit: decode re-derives position 127, no prefill of any kind.
+    out = eng.generate(base[:128], max_tokens=4)
+    assert calls == [], f"page-aligned covered prompt dispatched prefill: {calls}"
+    assert eng.prefix_cache_stats["hits"] == 1
+    cold = _engine().generate(base[:128], max_tokens=4)
+    assert out["tokens"] == cold["tokens"]
+
+
+def test_shared_page_refcounts_and_conservation():
+    """Chain entries share pages; eviction frees a page only when its last
+    referencing entry goes, and no page is ever leaked or double-freed."""
+    eng = _engine(max_slots=2, total_pages=12)
+    total = eng.ec.total_pages - 1  # page 0 reserved
+
+    def conserved():
+        held = sum(len(s.pages) for s in eng.slots if s is not None)
+        return len(eng.free_pages) + len(eng._page_refs) + held == total
+
+    p1 = (np.arange(1, 1 + 150, dtype=np.int32) % 96) + 1
+    eng.generate(p1, max_tokens=4)
+    assert conserved()
+    stats = eng.prefix_cache_stats
+    assert stats["entries"] == 3  # 64-prefix, 128-prefix, full 150
+    assert stats["cached_pages"] == 3  # 3 distinct pages, shared by chain
+    # Page 0 of the chain is referenced by all three entries.
+    first_page = next(iter(eng._prefix_cache.values()))["pages"][0]
+    assert eng._page_refs[first_page] == 3
+    # Evict one entry's worth: LRU entry (the 64-token prefix) goes first,
+    # but its page is shared -> nothing frees until all referents go.
+    before_free = len(eng.free_pages)
+    eng._evict_prefix_cache(1)
+    assert conserved()
+    assert len(eng.free_pages) >= before_free + 1
+    # Full drain.
+    eng._evict_prefix_cache(100)
+    assert not eng._prefix_cache and not eng._page_refs
+    assert len(eng.free_pages) == total
+    assert conserved()
+
+
+def test_partial_hit_retire_shares_prefix_pages():
+    """N requests extending one system prompt must not cache N copies of
+    it: a retiring partial-hit slot's new chain entries reference the
+    ALREADY-cached prefix pages, and the slot's duplicate copies free."""
+    sys_prompt = (np.arange(7, 7 + 128, dtype=np.int32) % 96) + 1  # 2 pages
+    eng = _engine()
+    q1 = np.concatenate([sys_prompt, np.array([3, 1, 4, 1, 5], np.int32)])
+    eng.generate(q1, max_tokens=4)
+    assert eng.prefix_cache_stats["cached_pages"] == 3  # 2 sys + 1 tail
+    for t in range(3):
+        q = np.concatenate([sys_prompt, np.array([10 + t, 2, 6], np.int32)])
+        eng.generate(q, max_tokens=4)
+    stats = eng.prefix_cache_stats
+    assert stats["partial_hits"] == 3
+    # Each extension adds ONE page (its own tail), never a sys copy.
+    assert stats["cached_pages"] == 6, stats
+    # The shared system-prompt pages are referenced by every full entry.
+    first = next(iter(eng._prefix_cache.values()))["pages"][0]
+    assert eng._page_refs[first] >= 4
+
+
+def test_admission_does_not_evict_its_own_prefix():
+    """Under page pressure a request must not evict the very entry it is
+    about to hit (lookup now precedes eviction, hit entry protected)."""
+    # Pool: 12 usable pages. Prompt ~150 tokens -> needs 4 pages/request
+    # (prompt 3 + budget slack). Decoy fills the cache so admission must
+    # evict; the protected entry must survive and the request must hit.
+    eng = _engine(max_slots=1, total_pages=13, prefill_buckets=(64, 256))
+    p1 = (np.arange(1, 1 + 150, dtype=np.int32) % 96) + 1
+    decoy = (np.arange(50, 50 + 150, dtype=np.int32) % 96) + 1
+    eng.generate(p1, max_tokens=4)
+    eng.generate(decoy, max_tokens=4)
+    # Cache now holds both prompts' chains; a re-run of p1 needs eviction
+    # room but must still hit p1's own entry.
+    out = eng.generate(p1, max_tokens=4)
+    assert eng.prefix_cache_stats["hits"] >= 1, eng.prefix_cache_stats
+    cold = _engine().generate(p1, max_tokens=4)
+    assert out["tokens"] == cold["tokens"]
+
+
+def test_partial_hit_ttft_beats_cold():
+    """Tail prefill over cached pages is measurably cheaper than a cold
+    full prefill (the routing payoff for shared system prompts). Programs
+    pre-warmed so compile time is excluded."""
+    eng = _engine()
+    eng.warmup(buckets=(512,))
+    sys_prompt = (np.arange(9, 9 + 448, dtype=np.int32) % 96) + 1  # 7 pages
+    tails = [np.array([3 + t, 1, 4], np.int32) for t in range(8)]
+    # Warm every program variant (full 512 prefill, tail-64 prefill, copy).
+    eng.generate(np.concatenate([sys_prompt, tails[6]]), max_tokens=2)
+    eng.generate(np.concatenate([sys_prompt, tails[7]]), max_tokens=2)
+    colds, warms = [], []
+    for t in range(3):
+        shifted = ((sys_prompt + 17 * (t + 1)) % 96) + 1  # new sys -> cold
+        colds.append(eng.generate(
+            np.concatenate([shifted, tails[t]]), max_tokens=2)["ttft_s"])
+        warms.append(eng.generate(
+            np.concatenate([shifted, tails[t + 3]]), max_tokens=2)["ttft_s"])
+    assert eng.prefix_cache_stats["partial_hits"] >= 4
+    cold, warm = min(colds), min(warms)
+    assert warm < cold, f"partial-hit ttft {warm:.4f}s not below cold {cold:.4f}s"
+
+
 def test_dense_layout_rejects_prefix_cache():
     with pytest.raises(ValueError):
         LLMEngine(CFG, engine_config=EngineConfig(
@@ -121,6 +275,32 @@ def test_openai_prefix_router_keys():
     m = openai_prefix_router(req({"messages": [{"role": "user", "content": "hi"}]}))
     assert m and m != a
     assert openai_prefix_router(req({"no": "prompt"})) == ""
+
+
+def test_tokenized_router_keys_on_first_page():
+    """With a tokenizer, the affinity key is the digest of the first
+    page_size TOKENS — exactly the engine's first chain-digest boundary —
+    so page-cache-compatible requests co-locate and others spread."""
+    import json
+
+    from ray_tpu.llm.openai import make_prefix_router
+    from ray_tpu.llm.tokenizer import load_tokenizer
+    from ray_tpu.serve.proxy import Request
+
+    tok = load_tokenizer(None)
+    policy = make_prefix_router(tok, page_size=8)
+
+    def req(prompt):
+        return Request("POST", "/v1/completions", {}, {},
+                       json.dumps({"prompt": prompt}).encode())
+
+    shared = "a shared system prompt that spans well past eight tokens of text"
+    a = policy(req(shared + " question one"))
+    b = policy(req(shared + " other question"))
+    assert a and a == b, "first-token-page sharers must co-locate"
+    # Divergence INSIDE the first page -> different keys.
+    c = policy(req("b shared system prompt that spans well past eight tokens"))
+    assert c != a
 
 
 def test_affinity_key_sticks_and_proxy_header_routes():
