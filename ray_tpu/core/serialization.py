@@ -43,6 +43,41 @@ def _restore_device_array(host):
     return jnp.asarray(host)
 
 
+def _restore_sharded_array(hosts, indices, dev_to_host, shape, axis_names,
+                           mesh_shape, spec):
+    """Reassemble a sharded jax.Array from UNIQUE per-shard host buffers
+    (`hosts`), their global indices, and the device->buffer map
+    (`dev_to_host`, one entry per mesh position — replicated shards share a
+    buffer).
+
+    Preferred path: rebuild an equivalent mesh (same axis names/shape, this
+    process's devices in the same flat order) and device_put each device's
+    shard onto the device at the same mesh position — one H2D per device,
+    never a global host copy. Degrade: a receiver with too few devices
+    assembles the global array on host from the shipped shard indices and
+    puts it on the default device (the send side still never gathered)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    n = 1
+    for s in mesh_shape:
+        n *= s
+    devs = jax.devices()
+    if len(devs) >= n:
+        mesh = Mesh(np.array(devs[:n]).reshape(mesh_shape), axis_names)
+        sharding = NamedSharding(mesh, spec)
+        arrays = [
+            jax.device_put(hosts[k], d)
+            for k, d in zip(dev_to_host, mesh.devices.flat)
+        ]
+        return jax.make_array_from_single_device_arrays(tuple(shape), sharding, arrays)
+    out = np.empty(tuple(shape), hosts[0].dtype)
+    for h, idx in zip(hosts, indices):
+        out[tuple(slice(a, b) for a, b in idx)] = h
+    return jax.numpy.asarray(out)
+
+
 class _RefAwarePickler(cloudpickle.CloudPickler):
     def __init__(self, file, protocol=_PROTOCOL, buffer_callback=None):
         super().__init__(file, protocol=protocol, buffer_callback=buffer_callback)
@@ -60,28 +95,77 @@ class _RefAwarePickler(cloudpickle.CloudPickler):
             if _context.on_ref_serialized is not None:
                 _context.on_ref_serialized(obj)
             return obj.__reduce__()
-        # Device-tensor transport (reference: gpu_object_manager — tensors
-        # bypass the generic pickle path). jax.Array's own reduce embeds the
-        # payload INSIDE the pickle stream (an extra copy each way); here a
-        # single-device array becomes one D2H transfer whose host buffer
-        # rides the protocol-5 out-of-band path — scatter-written straight
-        # into shared memory with no intermediate join, and restored with
-        # one device_put on the consuming worker. Multi-device (sharded)
-        # arrays keep the default path: their transport is XLA's job
-        # (in-program collectives / jax transfer), not the object store's.
+        # Device-tensor transport (reference: gpu_object_manager,
+        # gpu_object_manager.py:55-75 — tensors bypass the generic pickle
+        # path). jax.Array's own reduce embeds the payload INSIDE the pickle
+        # stream (an extra copy each way); here:
+        # - a single-device array becomes one D2H transfer whose host buffer
+        #   rides the protocol-5 out-of-band path — scatter-written straight
+        #   into shared memory with no intermediate join, and restored with
+        #   one device_put on the consuming worker;
+        # - a SHARDED (NamedSharding, fully-addressable) array ships ONE
+        #   OOB buffer PER SHARD plus its mesh/spec metadata — never a
+        #   whole-array host gather — and is reassembled shard-by-shard
+        #   onto an equivalent mesh of the receiver's devices
+        #   (_restore_sharded_array). Weight handoff (train->serve,
+        #   learner->actors) and elastic resharding move one shard at a
+        #   time at every hop.
+        # Non-Named shardings (GSPMD/positional) keep jax's default reduce.
         if "jax" in sys.modules and type(obj).__module__.startswith(("jaxlib", "jax")):
             import jax
 
             if isinstance(obj, jax.Array):
+                import numpy as np
+
                 try:
                     single = obj.is_fully_addressable and len(obj.sharding.device_set) == 1
                 except Exception:
                     single = False
                 if single:
-                    import numpy as np
-
                     host = np.asarray(jax.device_get(obj))
                     return (_restore_device_array, (host,))
+                try:
+                    from jax.sharding import NamedSharding
+
+                    if (
+                        isinstance(obj.sharding, NamedSharding)
+                        and getattr(obj, "is_fully_addressable", False)
+                    ):
+                        mesh = obj.sharding.mesh
+                        pos_of = {d: i for i, d in enumerate(mesh.devices.flat)}
+                        shards = sorted(obj.addressable_shards, key=lambda s: pos_of[s.device])
+                        shape = tuple(obj.shape)
+                        # Dedup replicated shards: a spec leaving a mesh axis
+                        # unused repeats the same global index on many
+                        # devices — ship each UNIQUE shard once and map
+                        # devices onto the shared buffer at restore (an
+                        # 8-way-replicated leaf costs 1x its bytes, not 8x).
+                        hosts: list = []
+                        indices: list = []
+                        dev_to_host: list[int] = []
+                        seen: dict = {}
+                        for s in shards:
+                            key = tuple(
+                                (sl.start or 0, dim if sl.stop is None else sl.stop)
+                                for sl, dim in zip(s.index, shape)
+                            )
+                            k = seen.get(key)
+                            if k is None:
+                                k = seen[key] = len(hosts)
+                                hosts.append(np.asarray(s.data))  # per-shard D2H
+                                indices.append(key)
+                            dev_to_host.append(k)
+                        return (
+                            _restore_sharded_array,
+                            (hosts, indices, dev_to_host, shape,
+                             tuple(mesh.axis_names), tuple(mesh.devices.shape),
+                             obj.sharding.spec),
+                        )
+                except Exception:
+                    # Arrays in odd states (donated/deleted buffers, exotic
+                    # shardings) degrade to jax's default reduce, matching
+                    # the guarded single-device check above.
+                    pass
         # Delegate to CloudPickler's override — that's where by-value
         # pickling of local functions/classes lives; returning
         # NotImplemented here would silently drop it.

@@ -37,7 +37,7 @@ class LLMServer:
     """
 
     def __init__(self, model_config: dict, engine_config: Optional[dict] = None,
-                 warmup_buckets: Optional[tuple] = None):
+                 warmup_buckets: Optional[tuple] = None, params=None):
         import jax
 
         from ray_tpu.llm.engine import EngineConfig, LLMEngine
@@ -45,7 +45,20 @@ class LLMServer:
 
         cfg = TransformerConfig(**model_config)
         ec = EngineConfig(**(engine_config or {}))
-        self.engine = LLMEngine(cfg, engine_config=ec)
+        # train->serve weight handoff: `params` may be an ObjectRef to a
+        # trained (possibly SHARDED) param tree in the object store — each
+        # replica fetches it here, in its own process, and sharded leaves
+        # arrive one OOB buffer per shard and reassemble onto this replica's
+        # devices (core/serialization.py; reference: tensor_transport
+        # keeping tensors off the generic path, gpu_object_manager.py:55-75).
+        if params is not None:
+            from ray_tpu.core.object_ref import ObjectRef
+
+            if isinstance(params, ObjectRef):
+                import ray_tpu as rt
+
+                params = rt.get(params, timeout=300.0)
+        self.engine = LLMEngine(cfg, params=params, engine_config=ec)
         if warmup_buckets:
             # Compile prefill/decode programs before the replica reports
             # healthy (vLLM-style startup warmup): cold compiles belong to
@@ -212,9 +225,12 @@ class LLMServer:
 def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
                   num_replicas: int = 1, max_ongoing_requests: Optional[int] = None,
                   warmup_buckets: Optional[tuple] = None,
-                  ray_actor_options: Optional[dict] = None):
+                  ray_actor_options: Optional[dict] = None,
+                  params=None):
     """Build a serve application serving this model. max_ongoing_requests
-    defaults to the engine's slot count (router admission == engine capacity)."""
+    defaults to the engine's slot count (router admission == engine capacity).
+    params: trained weights — a param tree or an ObjectRef to one (the
+    train->serve handoff; sharded trees move per-shard, see LLMServer)."""
     from ray_tpu import serve
     from ray_tpu.llm.engine import EngineConfig
 
@@ -234,4 +250,4 @@ def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
         max_ongoing_requests=max_ongoing_requests or ec.max_slots,
         ray_actor_options=aopts,
     )
-    return dep.bind(model_config, engine_config, warmup_buckets)
+    return dep.bind(model_config, engine_config, warmup_buckets, params)

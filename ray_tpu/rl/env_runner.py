@@ -33,7 +33,11 @@ class EnvRunner:
         self._prev_done = np.zeros(num_envs, bool)
 
     def set_weights(self, params: dict):
-        self.params = params
+        # Weights may arrive as device arrays (the learner ships its params
+        # through the object store's OOB device transport); the rollout path
+        # is pure numpy, so pin each leaf to host once here — gymnasium
+        # rejects device-typed actions.
+        self.params = {k: np.asarray(v) for k, v in params.items()}
         return True
 
     def sample(self) -> dict:

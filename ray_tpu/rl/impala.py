@@ -158,6 +158,13 @@ class IMPALALearner:
     def get_weights(self) -> dict:
         import jax
 
+        # Deliberately HOST arrays, not device arrays: env runners are pure
+        # numpy and must never initialize a JAX runtime (device contention
+        # with the learner on a TPU host), and the learner's jitted update
+        # donates self.params' buffers — a shipped live alias would be
+        # invalidated by the next update_batch. Device-array OOB transport
+        # (core/serialization.py) is for device->device handoff
+        # (train->serve); this hop is device->numpy by design.
         return {k: np.asarray(v) for k, v in jax.device_get(self.params).items()}
 
 

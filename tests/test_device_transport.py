@@ -27,20 +27,58 @@ def test_jax_array_bfloat16_roundtrip():
     np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
 
 
-def test_sharded_array_falls_back_to_default_pickle():
+def test_sharded_array_ships_per_shard_buffers():
+    """A sharded array rides the wire as one OOB buffer PER SHARD (no
+    whole-array host gather) and reassembles onto an equivalent mesh of the
+    receiver's devices with the sharding intact."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ray_tpu.parallel import MeshSpec
 
     mesh = MeshSpec(data=-1).build()
+    n_dev = len(mesh.devices.flat)
     x = jax.device_put(
-        jnp.arange(64, dtype=jnp.float32),
+        jnp.arange(64 * n_dev, dtype=jnp.float32).reshape(n_dev * 8, 8),
         NamedSharding(mesh, P("data")),
     )
     assert len(x.sharding.device_set) > 1
-    data, _ = S.serialize(x)
-    y = S.deserialize(data)
+    parts, _refs, _total = S.serialize_parts(x)
+    # OOB layout: tag, then (len, payload) per buffer, then the pickle body.
+    # Every shard is its own buffer, each exactly shard-sized — the absence
+    # of any full-array-sized buffer proves no host gather happened.
+    payloads = parts[2:-1:2]
+    shard_bytes = x.nbytes // n_dev
+    assert len(payloads) == n_dev, f"expected {n_dev} shard buffers"
+    assert all(len(p) == shard_bytes for p in payloads)
+    y = S.deserialize(b"".join(bytes(p) for p in parts))
+    assert isinstance(y, jax.Array)
     np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    # Sharding survives: same axis layout, one shard per device again.
+    assert len(y.sharding.device_set) == n_dev
+    assert [s.index for s in y.addressable_shards] == [s.index for s in x.addressable_shards]
+
+
+def test_sharded_replicated_axis_roundtrip():
+    """Partial replication (a spec that leaves an axis unused) round-trips:
+    every device gets its (duplicate) shard, values and layout intact."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import MeshSpec
+
+    mesh = MeshSpec(data=2, tensor=-1).build()
+    n_tensor = mesh.shape["tensor"]
+    x = jax.device_put(
+        jnp.arange(256, dtype=jnp.float32).reshape(16, 16),
+        NamedSharding(mesh, P(None, "tensor")),  # replicated over data
+    )
+    parts, _refs, _total = S.serialize_parts(x)
+    # Replicated shards dedup on the wire: one buffer per UNIQUE shard
+    # (n_tensor), not one per device (2 * n_tensor).
+    payloads = parts[2:-1:2]
+    assert len(payloads) == n_tensor, f"replicas not deduped: {len(payloads)}"
+    y = S.deserialize(b"".join(bytes(p) for p in parts))
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    assert len(y.sharding.device_set) == len(x.sharding.device_set)
 
 
 def test_device_array_through_object_store():
