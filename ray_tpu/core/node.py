@@ -209,7 +209,7 @@ class NodeDaemon:
         """(env overrides, extra sys.path entries, cwd, hash) for a runtime
         env spec; packages cached per URI under the session dir."""
         if not renv:
-            return {}, [], None, "", None
+            return {}, [], None, "", None, None
         from ray_tpu.core import runtime_env as _re
 
         async def kv_get(uri: str):
@@ -217,12 +217,22 @@ class NodeDaemon:
 
         cache_root = os.path.join(self.session_dir, "runtime_envs")
         os.makedirs(cache_root, exist_ok=True)
-        env_vars, pypath, cwd, python_exe = await _re.materialize(renv, cache_root, kv_get)
-        return env_vars, pypath, cwd, renv.get("hash", ""), python_exe
+        try:
+            env_vars, pypath, cwd, python_exe, container = await _re.materialize(
+                renv, cache_root, kv_get
+            )
+        except Exception as e:
+            # Uniform marker: submitters classify "runtime_env" errors as
+            # PERMANENT for the task key and fail the task instead of
+            # retrying the lease forever (worker.py _request_lease) — a
+            # missing conda env or failed build fails identically every try.
+            raise RuntimeError(f"runtime_env setup failed: {e}") from e
+        return env_vars, pypath, cwd, renv.get("hash", ""), python_exe, container
 
     def _spawn_worker(self, env_overrides: dict | None = None, pypath: list | None = None,
                       cwd: str | None = None, env_hash: str = "",
-                      python_exe: str | None = None) -> WorkerRecord:
+                      python_exe: str | None = None,
+                      container: dict | None = None) -> WorkerRecord:
         worker_id = WorkerID.from_random().hex()
         env = {**os.environ, **self._spawn_env, **(env_overrides or {})}
         env["RAYTPU_WORKER_ID"] = worker_id
@@ -253,10 +263,19 @@ class NodeDaemon:
             stdout = open(os.path.join(self.log_dir, f"worker-{worker_id}.out"), "ab")
             stderr = open(os.path.join(self.log_dir, f"worker-{worker_id}.err"), "ab")
             env.setdefault("PYTHONUNBUFFERED", "1")
+        # python_exe: a runtime-env venv/conda interpreter (dependency
+        # isolation); defaults to the daemon's own. A container spec wraps
+        # the whole worker command in the engine invocation instead.
+        cmd = [python_exe or sys.executable, "-m", "ray_tpu.core.worker_main"]
+        if container is not None:
+            from ray_tpu.core import runtime_env as _re
+
+            cmd = _re.container_spawn_command(
+                container, container["engine"], env, self.session_dir, repo_root,
+                cwd=cwd,
+            )
         proc = subprocess.Popen(
-            # python_exe: a runtime-env venv's interpreter (pip isolation);
-            # defaults to the daemon's own.
-            [python_exe or sys.executable, "-m", "ray_tpu.core.worker_main"],
+            cmd,
             env=env,
             cwd=cwd,
             stdout=stdout,
@@ -312,13 +331,13 @@ class NodeDaemon:
             pass
 
     async def _acquire_worker(self, renv: Optional[dict] = None) -> WorkerRecord:
-        env_vars, pypath, cwd, env_hash, python_exe = await self._materialize_env(renv)
+        env_vars, pypath, cwd, env_hash, python_exe, container = await self._materialize_env(renv)
         pool = self.idle_workers.get(env_hash, [])
         while pool:
             w = pool.pop()
             if w.state == "IDLE" and w.conn and not w.conn.closed:
                 return w
-        record = self._spawn_worker(env_vars, pypath, cwd, env_hash, python_exe)
+        record = self._spawn_worker(env_vars, pypath, cwd, env_hash, python_exe, container)
         await asyncio.wait_for(record.ready, timeout=self.config.worker_start_timeout_s)
         return record
 

@@ -18,7 +18,18 @@ a cached ``--system-site-packages`` venv keyed by the requirement set and
 spawns the worker from that venv's interpreter, so two jobs with conflicting
 dependency versions coexist on one cluster; reference:
 _private/runtime_env/pip.py + uri_cache.py), ``pip_install_options`` (extra
-pip args, e.g. ``--no-index`` for air-gapped local-path installs).
+pip args, e.g. ``--no-index`` for air-gapped local-path installs),
+``conda`` (a NAMED existing conda env, or a dict of environment.yml content
+the daemon creates once per content hash — the worker then runs on that
+env's hermetic interpreter; reference: _private/runtime_env/conda.py),
+``container`` ({"image": ..., "run_options": [...]}: the worker process
+launches inside a podman/docker container wrapping the worker command with
+the engine invocation — host networking + /dev/shm so the RPC plane and the
+shared-memory object store still reach it; reference:
+_private/runtime_env/image_uri.py). Conda and container need their binaries
+on the NODE: discovery honors ``RAYTPU_CONDA_EXE`` / ``RAYTPU_CONTAINER_ENGINE``
+overrides (also the test seam), then falls back to PATH lookup, and raises a
+clear per-lease error when absent.
 """
 from __future__ import annotations
 
@@ -67,10 +78,29 @@ def package_runtime_env(core, renv: dict) -> dict:
     content hash — the reference's URI cache), env_vars pass through."""
     if renv.get("_resolved"):
         return renv  # already packaged (e.g. reused from another task's options)
-    known = {"env_vars", "working_dir", "py_modules", "pip", "pip_install_options"}
+    known = {"env_vars", "working_dir", "py_modules", "pip", "pip_install_options",
+             "conda", "container"}
     unknown = set(renv) - known
     if unknown:
         raise ValueError(f"unsupported runtime_env keys {sorted(unknown)}; supported: {sorted(known)}")
+    if renv.get("conda") is not None and renv.get("pip"):
+        # Both resolve to "which interpreter runs the worker" — ambiguous.
+        # (The reference nests pip inside the conda env spec instead; here:
+        # put pip deps in the conda dict's dependencies.)
+        raise ValueError("runtime_env cannot set both 'conda' and 'pip'; "
+                         "add pip deps inside the conda environment dict")
+    if renv.get("conda") is not None and not isinstance(renv["conda"], (str, dict)):
+        raise ValueError("runtime_env 'conda' must be an env NAME (str) or an "
+                         "environment.yml dict")
+    if renv.get("container") is not None:
+        c = renv["container"]
+        if not isinstance(c, dict) or not isinstance(c.get("image"), str) or not c["image"]:
+            raise ValueError("runtime_env 'container' must be a dict with an 'image' str")
+        if renv.get("pip") or renv.get("conda") is not None:
+            # The worker runs the IMAGE's interpreter; a host-built venv or
+            # conda env would be silently ignored inside it.
+            raise ValueError("runtime_env 'container' cannot combine with "
+                             "'pip'/'conda' — bake dependencies into the image")
     cache = getattr(core, "_renv_pkg_cache", None)
     if cache is None:
         cache = core._renv_pkg_cache = {}
@@ -113,9 +143,18 @@ def package_runtime_env(core, renv: dict) -> dict:
                 resolved.append({"req": str(r)})
         spec["pip"] = resolved
         spec["pip_install_options"] = list(renv.get("pip_install_options", []))
+    if renv.get("conda") is not None:
+        spec["conda"] = renv["conda"]
+    if renv.get("container") is not None:
+        spec["container"] = {
+            "image": renv["container"]["image"],
+            "run_options": list(renv["container"].get("run_options", [])),
+        }
     spec["hash"] = hashlib.sha1(
         json.dumps(
-            {k: spec.get(k) for k in ("env_vars", "pkgs", "pip", "pip_install_options")},
+            {k: spec.get(k) for k in (
+                "env_vars", "pkgs", "pip", "pip_install_options", "conda", "container",
+            )},
             sort_keys=True,
         ).encode()
     ).hexdigest()[:16]
@@ -233,11 +272,168 @@ async def _build_venv(spec: dict, cache_root: str, kv_get) -> str:
     return py
 
 
-async def materialize(spec: dict, cache_root: str, kv_get) -> tuple[dict, list, str | None, str | None]:
-    """Daemon-side: download/extract packages (cached per URI) and build the
-    pip venv if requested. Returns (env_vars, extra sys.path entries,
-    cwd or None, python executable or None). ``kv_get`` is an async
-    callable uri -> bytes."""
+# -- conda -------------------------------------------------------------------
+
+def _conda_exe() -> str | None:
+    """Conda binary discovery: RAYTPU_CONDA_EXE override (also the test
+    seam), then PATH, then the standard CONDA_EXE activation var."""
+    import shutil
+
+    for cand in (os.environ.get("RAYTPU_CONDA_EXE"), shutil.which("conda"),
+                 os.environ.get("CONDA_EXE")):
+        if cand and os.path.exists(cand):
+            return cand
+    return None
+
+
+def _conda_yaml(d: dict) -> str:
+    """Emit environment.yml from a dict spec ({name?, channels?,
+    dependencies?} with the standard nested {"pip": [...]} entry) — tiny
+    hand emitter so pyyaml never becomes a dependency of the daemon."""
+    lines: list[str] = []
+    if d.get("name"):
+        lines.append(f"name: {d['name']}")
+    for sect in ("channels", "dependencies"):
+        if d.get(sect):
+            lines.append(f"{sect}:")
+            for item in d[sect]:
+                if isinstance(item, dict):
+                    for k, v in item.items():
+                        lines.append(f"  - {k}:")
+                        lines.extend(f"    - {x}" for x in v)
+                else:
+                    lines.append(f"  - {item}")
+    return "\n".join(lines) + "\n"
+
+
+_conda_locks: dict[str, Any] = {}
+
+
+async def _resolve_conda(spec: dict, cache_root: str) -> str:
+    """Python executable for the spec's conda env: a NAMED env resolves
+    under the conda base; a dict spec creates a content-hash-keyed env once
+    per node (reference: conda.py builds under per-env locks with the same
+    cache-or-create shape)."""
+    import asyncio
+    import subprocess
+    import threading
+
+    conda = spec["conda"]
+    exe = _conda_exe()
+    if exe is None:
+        raise RuntimeError(
+            "runtime_env requests a conda env but no conda binary is available "
+            "on this node (install conda or set RAYTPU_CONDA_EXE)"
+        )
+    loop = asyncio.get_running_loop()
+    if isinstance(conda, str):
+        def resolve_named():
+            out = subprocess.run([exe, "info", "--base"], capture_output=True,
+                                 text=True, check=True).stdout.strip()
+            py = (os.path.join(out, "bin", "python") if conda == "base"
+                  else os.path.join(out, "envs", conda, "bin", "python"))
+            if not os.path.exists(py):
+                raise RuntimeError(f"conda env {conda!r} not found ({py} missing)")
+            return py
+
+        return await loop.run_in_executor(None, resolve_named)
+
+    key = hashlib.sha1(json.dumps(conda, sort_keys=True).encode()).hexdigest()[:16]
+    env_dir = os.path.join(cache_root, "conda", key)
+    py = os.path.join(env_dir, "bin", "python")
+    if os.path.exists(py):
+        return py
+
+    def build():
+        import shutil
+        import threading as _th
+
+        tmp = f"{env_dir}.tmp{os.getpid()}_{_th.get_ident()}"
+        os.makedirs(os.path.dirname(env_dir), exist_ok=True)
+        yml = f"{tmp}.yml"
+        with open(yml, "w") as f:
+            f.write(_conda_yaml(conda))
+        proc = subprocess.run(
+            [exe, "env", "create", "-y", "-p", tmp, "-f", yml],
+            capture_output=True, text=True,
+        )
+        os.unlink(yml)
+        if proc.returncode != 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"conda env create failed for runtime_env {spec.get('hash')}:\n"
+                f"{proc.stderr[-2000:]}"
+            )
+        try:
+            os.rename(tmp, env_dir)
+        except OSError:  # concurrent build won
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    import asyncio as _aio
+
+    lock = _conda_locks.setdefault(f"{cache_root}:{key}", _aio.Lock())
+    async with lock:
+        if not os.path.exists(py):
+            await loop.run_in_executor(None, build)
+    return py
+
+
+# -- container ----------------------------------------------------------------
+
+def _container_engine() -> str | None:
+    """Engine discovery: RAYTPU_CONTAINER_ENGINE override (also the test
+    seam), then podman, then docker."""
+    import shutil
+
+    cand = os.environ.get("RAYTPU_CONTAINER_ENGINE")
+    if cand:
+        return cand if os.path.exists(cand) else shutil.which(cand)
+    return shutil.which("podman") or shutil.which("docker")
+
+
+# Env prefixes forwarded into the container (the worker's control-plane
+# coordinates + interpreter config; everything else stays host-side).
+_CONTAINER_ENV_PREFIXES = ("RAYTPU_", "PYTHON", "JAX_", "XLA_", "TPU_")
+
+
+def container_spawn_command(container: dict, engine: str, env: dict,
+                            session_dir: str, repo_root: str,
+                            cwd: str | None = None) -> list:
+    """The engine invocation that runs the worker inside the image.
+
+    Host networking (the worker serves its gRPC-equivalent port and dials
+    the controller by host address), host IPC + /dev/shm (the shared-memory
+    object store is a /dev/shm arena the worker maps directly), and the
+    session dir + framework repo volume-mounted at identical paths so the
+    propagated PYTHONPATH and store path stay valid inside. run_options
+    append last, so users can override mounts/flags. The image must provide
+    a `python` with this framework's dependencies."""
+    args = [
+        engine, "run", "--rm",
+        "--network=host", "--ipc=host",
+        "-v", "/dev/shm:/dev/shm",
+        "-v", f"{session_dir}:{session_dir}",
+        "-v", f"{repo_root}:{repo_root}",
+    ]
+    if cwd:
+        # Popen's cwd only moves the host-side engine client; the worker's
+        # working_dir must be set INSIDE the container (it is extracted
+        # under the session dir, which is volume-mounted at the same path).
+        args += ["-w", cwd]
+    for k in sorted(env):
+        if k.startswith(_CONTAINER_ENV_PREFIXES):
+            args += ["--env", f"{k}={env[k]}"]
+    args += list(container.get("run_options", []))
+    args += [container["image"], "python", "-m", "ray_tpu.core.worker_main"]
+    return args
+
+
+async def materialize(spec: dict, cache_root: str, kv_get) -> tuple[dict, list, str | None, str | None, dict | None]:
+    """Daemon-side: download/extract packages (cached per URI), build the
+    pip venv / conda env if requested, resolve the container engine.
+    Returns (env_vars, extra sys.path entries, cwd or None, python
+    executable or None, container spec w/ engine or None). ``kv_get`` is an
+    async callable uri -> bytes."""
     env_vars = dict(spec.get("env_vars", {}))
     pypath: list[str] = []
     cwd = None
@@ -249,4 +445,15 @@ async def materialize(spec: dict, cache_root: str, kv_get) -> tuple[dict, list, 
     python_exe = None
     if spec.get("pip"):
         python_exe = await _build_venv(spec, cache_root, kv_get)
-    return env_vars, pypath, cwd, python_exe
+    if spec.get("conda") is not None:
+        python_exe = await _resolve_conda(spec, cache_root)
+    container = None
+    if spec.get("container") is not None:
+        engine = _container_engine()
+        if engine is None:
+            raise RuntimeError(
+                "runtime_env requests a container but neither podman nor docker "
+                "is available on this node (set RAYTPU_CONTAINER_ENGINE)"
+            )
+        container = dict(spec["container"], engine=engine)
+    return env_vars, pypath, cwd, python_exe, container

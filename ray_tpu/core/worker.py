@@ -182,8 +182,21 @@ class _KeySubmitter:
                 raise
             self.workers.append(w)
         except Exception as e:
-            logger.warning("lease request failed for %s: %s", self.key[:40], e)
-            await asyncio.sleep(self.core.config.rpc_retry_delay_s)
+            # Runtime-env materialization failures are PERMANENT for this
+            # task key (the env spec is part of the key): a missing conda
+            # binary / container engine / failed env build will fail
+            # identically on every retry — surface it to the caller instead
+            # of retrying the lease forever (reference: runtime-env agent
+            # setup errors fail the lease with a creation error).
+            if "runtime_env" in str(e):
+                for spec, fut in self.queue:
+                    self.core._fail_task_returns(spec, RuntimeError(str(e)))
+                    if not fut.done():
+                        fut.set_result(False)
+                self.queue.clear()
+            else:
+                logger.warning("lease request failed for %s: %s", self.key[:40], e)
+                await asyncio.sleep(self.core.config.rpc_retry_delay_s)
         finally:
             self.pending_lease_requests -= 1
             self.pump()
