@@ -9,6 +9,7 @@ from ray_tpu.train.session import get_checkpoint, report
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -20,7 +21,7 @@ from ray_tpu.tune.search import (
     sample_from,
     uniform,
 )
-from ray_tpu.tune.searcher import BasicVariantGenerator, Searcher, TPESearcher
+from ray_tpu.tune.searcher import BasicVariantGenerator, BOHBSearcher, Searcher, TPESearcher
 from ray_tpu.tune.tuner import (
     ResultGrid,
     TrialResult,
@@ -31,8 +32,10 @@ from ray_tpu.tune.tuner import (
 
 __all__ = [
     "ASHAScheduler",
+    "BOHBSearcher",
     "BasicVariantGenerator",
     "FIFOScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",

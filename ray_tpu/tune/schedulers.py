@@ -79,6 +79,59 @@ class ASHAScheduler(TrialScheduler):
         return CONTINUE
 
 
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far falls below the median of the
+    other trials' RUNNING AVERAGES at comparable time (Vizier's median
+    stopping; reference: tune/schedulers/median_stopping_rule.py).
+
+    Gentler than successive halving: a trial is judged against smoothed
+    peers, never a fixed rung cutoff, so noisy-but-promising trials survive
+    early wobbles."""
+
+    def __init__(self, metric: str, mode: str = "max", grace_period: int = 4,
+                 min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        # trial_id -> [(t, value)] in arrival order
+        self._results: dict[str, list[tuple[int, float]]] = {}
+
+    def _running_avg(self, trial_id: str, upto_t: int) -> Optional[float]:
+        vals = [v for (t, v) in self._results.get(trial_id, []) if t <= upto_t]
+        return sum(vals) / len(vals) if vals else None
+
+    def on_trial_result(self, trial, metrics: dict) -> str:
+        value = metrics.get(self.metric)
+        t = int(metrics.get(self.time_attr, 0))
+        if value is None:
+            return CONTINUE
+        self._results.setdefault(trial.trial_id, []).append((t, float(value)))
+        if t < self.grace_period:
+            return CONTINUE
+        others = [
+            avg for tid in self._results if tid != trial.trial_id
+            for avg in [self._running_avg(tid, t)] if avg is not None
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        n = len(others)
+        median = (others[n // 2] if n % 2 else
+                  0.5 * (others[n // 2 - 1] + others[n // 2]))
+        own = [v for (_, v) in self._results[trial.trial_id]]
+        best = max(own) if self.mode == "max" else min(own)
+        worse = best < median if self.mode == "max" else best > median
+        return STOP if worse else CONTINUE
+
+    def on_trial_complete(self, trial, metrics):
+        # Keep the history: completed trials still define the median bar.
+        pass
+
+
 class PopulationBasedTraining(TrialScheduler):
     """PBT: every perturbation_interval, bottom-quantile trials clone a
     top-quantile trial's checkpoint (exploit) and mutate hyperparams

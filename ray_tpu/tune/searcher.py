@@ -237,3 +237,78 @@ class TPESearcher(Searcher):
             self.rng.setstate(tuple(
                 tuple(x) if isinstance(x, list) else x for x in rng_state
             ))
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB-style model-based search (Falkner et al. 2018; reference:
+    tune/search/bohb/ TuneBOHB paired with schedulers/hb_bohb.py).
+
+    The BOHB coupling: TPE densities are fitted PER BUDGET from every
+    INTERMEDIATE result (on_trial_result, keyed by ``time_attr``), and
+    suggestions always come from the LARGEST budget that has accumulated
+    ``min_points_in_model`` observations — early rungs seed the model
+    cheaply, deep rungs refine it. Pair with ASHAScheduler, the async
+    successive-halving counterpart of BOHB's HyperBand: the scheduler
+    allocates budgets, this searcher learns from every rung it produces.
+    (Plain TPESearcher only learns from terminal results.)
+    """
+
+    def __init__(self, param_space: dict, metric: str, mode: str = "max",
+                 n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24,
+                 min_points_in_model: Optional[int] = None,
+                 time_attr: str = "training_iteration",
+                 seed: Optional[int] = None):
+        super().__init__(param_space, metric, mode, n_initial, gamma,
+                         n_candidates, seed)
+        self.time_attr = time_attr
+        self.min_points = min_points_in_model or (len(self._dims) + 2)
+        # budget -> [(config, score at that budget)]
+        self._budget_obs: dict[int, list[tuple[dict, float]]] = {}
+        # (trial_id, budget) pairs already recorded: the controller reports
+        # the FINAL result through on_trial_result AND on_trial_complete —
+        # without dedup every completed trial would be double-weighted in
+        # its rung's density model.
+        self._seen: set = set()
+
+    def _record(self, trial_id: str, metrics: Optional[dict], pop: bool) -> None:
+        cfg = (self._pending.pop(trial_id, None) if pop
+               else self._pending.get(trial_id))
+        if cfg is None or not metrics or self.metric not in metrics:
+            return
+        budget = int(metrics.get(self.time_attr, 0))
+        if (trial_id, budget) in self._seen:
+            return
+        self._seen.add((trial_id, budget))
+        self._budget_obs.setdefault(budget, []).append(
+            (cfg, float(metrics[self.metric]))
+        )
+        # Model pool <- the deepest budget with enough points (BOHB's rule).
+        for b in sorted(self._budget_obs, reverse=True):
+            if len(self._budget_obs[b]) >= self.min_points:
+                self._observations = self._budget_obs[b]
+                return
+
+    def on_trial_result(self, trial_id: str, metrics: dict) -> None:
+        self._record(trial_id, metrics, pop=False)
+
+    def on_trial_complete(self, trial_id: str, metrics: Optional[dict]) -> None:
+        self._record(trial_id, metrics, pop=True)
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["budget_obs"] = {str(b): obs for b, obs in self._budget_obs.items()}
+        state["seen"] = sorted(list(p) for p in self._seen)
+        return state
+
+    def set_state(self, state: dict) -> None:
+        super().set_state(state)
+        self._budget_obs = {
+            int(b): [(c, float(s)) for c, s in obs]
+            for b, obs in state.get("budget_obs", {}).items()
+        }
+        self._seen = {(t, int(b)) for t, b in state.get("seen", [])}
+        for b in sorted(self._budget_obs, reverse=True):
+            if len(self._budget_obs[b]) >= self.min_points:
+                self._observations = self._budget_obs[b]
+                break

@@ -81,6 +81,127 @@ def test_searcher_observes_and_suggests():
     assert len(s2._observations) == len(s._observations)
 
 
+def test_bohb_learns_from_intermediate_budgets():
+    """BOHB's defining behavior vs plain TPE: intermediate results at rung
+    budgets feed the model, and the model pool tracks the DEEPEST budget
+    with enough observations (reference: tune/search/bohb/ TuneBOHB)."""
+    from ray_tpu.tune import BOHBSearcher
+
+    sp = {"x": tune.uniform(0.0, 1.0)}
+    s = BOHBSearcher(sp, metric="m", mode="max", n_initial=3,
+                     min_points_in_model=3, seed=1)
+    # Three trials report at budgets 1 and 2 WITHOUT completing.
+    for i in range(3):
+        cfg = s.suggest(f"t{i}")
+        s.on_trial_result(f"t{i}", {"m": -abs(cfg["x"] - 0.5), "training_iteration": 1})
+        s.on_trial_result(f"t{i}", {"m": -abs(cfg["x"] - 0.5), "training_iteration": 2})
+    # Model is live from intermediate results alone (budget 2 has 3 points).
+    assert len(s._observations) == 3
+    assert s._budget_obs.keys() == {1, 2}
+    # The controller reports the FINAL result via on_trial_result AND
+    # on_trial_complete — the pool must not double-count it.
+    s.on_trial_complete("t0", {"m": 0.0, "training_iteration": 2})
+    assert len(s._budget_obs[2]) == 3, "final result double-recorded"
+    sugg = [s.suggest(f"p{i}")["x"] for i in range(8)]
+    assert np.mean(np.abs(np.asarray(sugg) - 0.5)) < 0.35
+    # State round-trips (sweep persistence), budgets intact.
+    state = json.loads(json.dumps(s.get_state()))
+    s2 = BOHBSearcher(sp, metric="m", mode="max", n_initial=3,
+                      min_points_in_model=3, seed=1)
+    s2.set_state(state)
+    assert {int(k) for k in s2._budget_obs} == {1, 2}
+    assert len(s2._observations) == 3
+
+
+def test_bohb_with_asha_end_to_end(tmp_path):
+    """BOHB + ASHA sweep through the Tuner: multi-iteration trials report
+    per-iteration scores; the sweep finds a near-optimal x and the searcher
+    accumulated rung observations along the way."""
+    from ray_tpu.tune import ASHAScheduler, BOHBSearcher
+
+    def trainable(config):
+        for it in range(1, 5):
+            # Score improves with budget; ordering by |x-0.3| is stable.
+            tune.report({"score": -abs(config["x"] - 0.3) + 0.01 * it,
+                         "training_iteration": it})
+
+    space = {"x": tune.uniform(-2.0, 2.0)}
+    searcher = BOHBSearcher(space, metric="score", mode="max",
+                            n_initial=4, min_points_in_model=4, seed=3)
+    tuner = Tuner(
+        trainable,
+        param_space=space,
+        tune_config=TuneConfig(
+            num_samples=16, metric="score", mode="max",
+            search_alg=searcher,
+            scheduler=ASHAScheduler(metric="score", mode="max", max_t=4,
+                                    grace_period=1, reduction_factor=2),
+            max_concurrent_trials=1, seed=3,
+        ),
+        run_config=RunConfig(name="bohb-asha", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = max(r.metrics["score"] for r in grid if r.error is None and r.metrics)
+    assert best > -0.3, f"BOHB+ASHA best {best} nowhere near optimum"
+    assert searcher._budget_obs, "no rung observations reached the searcher"
+
+
+def test_median_stopping_rule_unit():
+    from ray_tpu.tune import MedianStoppingRule
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    rule = MedianStoppingRule(metric="score", mode="max", grace_period=2,
+                              min_samples_required=2)
+    # Two healthy trials establish the median bar.
+    for t in (1, 2, 3):
+        assert rule.on_trial_result(T("good1"), {"score": 10.0, "training_iteration": t}) == CONTINUE
+        assert rule.on_trial_result(T("good2"), {"score": 9.0, "training_iteration": t}) == CONTINUE
+    # Within grace: a bad trial survives.
+    assert rule.on_trial_result(T("bad"), {"score": 1.0, "training_iteration": 1}) == CONTINUE
+    # Past grace and below the median of running averages: stopped.
+    assert rule.on_trial_result(T("bad"), {"score": 1.0, "training_iteration": 2}) == STOP
+    # A trial ABOVE the median keeps going at the same step.
+    assert rule.on_trial_result(T("good3"), {"score": 12.0, "training_iteration": 2}) == CONTINUE
+
+
+def test_median_stopping_in_sweep(tmp_path):
+    """End-to-end: bad trials stop early (fewer iterations reported), good
+    trials run to completion."""
+    from ray_tpu.tune import MedianStoppingRule
+
+    def trainable(config):
+        import time as _t
+
+        base = config["q"]
+        for it in range(1, 7):
+            tune.report({"score": base, "training_iteration": it})
+            _t.sleep(0.4)  # let the controller poll between reports
+
+    tuner = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([1.0, 1.0, 10.0, 10.0])},
+        tune_config=TuneConfig(
+            num_samples=1, metric="score", mode="max",
+            scheduler=MedianStoppingRule(metric="score", mode="max",
+                                         grace_period=2, min_samples_required=2),
+            max_concurrent_trials=4, seed=0,
+        ),
+        run_config=RunConfig(name="medstop", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    by_q = {}
+    for r in grid:
+        if r.error is None and r.metrics:
+            by_q.setdefault(r.config["q"], []).append(
+                int(r.metrics.get("training_iteration", 0)))
+    assert max(by_q[10.0]) == 6, by_q  # good trials ran out the budget
+    assert min(by_q[1.0]) < 6, by_q  # at least one bad trial stopped early
+
+
 _RESUME_SCRIPT = """
 import os, sys, json, tempfile
 sys.path.insert(0, {repo!r})
