@@ -11,11 +11,23 @@ Role-equivalent to the reference's RLlib core split (rllib/):
   - DQN (off-policy): replay-buffer actor (uniform/prioritized,
     rllib/utils/replay_buffers/) fed by ASYNC collectors that overlap
     learning (IMPALA-shaped pipeline), double-Q target network, PER
-    importance weights.
+    importance weights;
+- Offline RL (algorithms/{bc,cql}/) -> rl/offline.py: BC + CQL trained
+  from saved transition datasets streamed through ray_tpu.data.
 """
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.offline import (
+    BC,
+    BCConfig,
+    CQL,
+    CQLConfig,
+    collect_transitions,
+    evaluate_policy,
+    load_transitions,
+    save_transitions,
+)
 from ray_tpu.rl.sac import SAC, SACConfig
 from ray_tpu.rl.replay_buffer import (
     PrioritizedReplayBuffer,
@@ -24,6 +36,10 @@ from ray_tpu.rl.replay_buffer import (
 )
 
 __all__ = [
+    "BC",
+    "BCConfig",
+    "CQL",
+    "CQLConfig",
     "DQN",
     "DQNConfig",
     "IMPALA",
@@ -35,4 +51,8 @@ __all__ = [
     "PrioritizedReplayBuffer",
     "ReplayBuffer",
     "ReplayBufferActor",
+    "collect_transitions",
+    "evaluate_policy",
+    "load_transitions",
+    "save_transitions",
 ]

@@ -1,0 +1,490 @@
+"""Offline RL: behavior cloning (BC) + conservative Q-learning (CQL).
+
+Role-equivalent to the reference's offline algorithms
+(rllib/algorithms/bc/ — supervised policy learning from logged data — and
+rllib/algorithms/cql/ — SAC plus a conservative logsumexp Q-regularizer,
+Kumar et al. 2020), re-shaped for this runtime:
+
+- Datasets are the replay-buffer transition format (dict of arrays: obs,
+  actions, rewards, next_obs, terms) saved as one .npz, and batches stream
+  through ray_tpu.data — column blocks in the object store, shuffled and
+  re-batched by the streaming executor per epoch, the same machinery that
+  feeds Train (reference: BC/CQL read via ray.data input pipelines).
+- Learners are single jitted XLA programs; training never touches an env.
+  The env appears only in evaluate() rollouts.
+- BC handles both action spaces: discrete -> cross-entropy on logits
+  (module.py policy tower), continuous -> MSE to a tanh-squashed
+  deterministic head (the standard BC formulation).
+- CQL is continuous-control (on the SAC param layout, sac.py): twin-critic
+  soft Bellman backup on dataset transitions plus the CQL(H) penalty
+  alpha * (logsumexp_a Q(s, a) - Q(s, a_data)), with the logsumexp estimated
+  over uniform + policy + next-policy action samples with importance
+  corrections — pessimism about out-of-distribution actions is what lets it
+  improve over the behavior policy where BC can only imitate it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ray_tpu.rl.q_runner import TransitionCollector
+from ray_tpu.rl.sac import (
+    LOG_STD_MAX,
+    LOG_STD_MIN,
+    sac_init_params,
+)
+
+# ---------------------------------------------------------------------------
+# datasets: save/load + streaming batches through ray_tpu.data
+# ---------------------------------------------------------------------------
+
+TRANSITION_KEYS = ("obs", "actions", "rewards", "next_obs", "terms")
+
+
+def save_transitions(path: str, transitions: dict) -> None:
+    """Persist a transition dict (replay-buffer format) as one .npz."""
+    np.savez_compressed(path, **{k: np.asarray(transitions[k]) for k in TRANSITION_KEYS})
+
+
+def load_transitions(path: str) -> dict:
+    with np.load(path) as z:
+        return {k: z[k] for k in TRANSITION_KEYS}
+
+
+def transitions_dataset(transitions: dict, n_shards: int = 8):
+    """Transition dict -> ray_tpu.data Dataset of column blocks (rows =
+    transitions), shardable/shuffleable by the streaming executor."""
+    from ray_tpu.data import from_blocks
+    from ray_tpu.data import block as B
+
+    n = len(transitions["obs"])
+    bounds = np.linspace(0, n, n_shards + 1).astype(int)
+    blocks = []
+    for i in range(n_shards):
+        lo, hi = bounds[i], bounds[i + 1]
+        if hi > lo:
+            blocks.append(
+                B.block_from_batch({k: np.asarray(v[lo:hi]) for k, v in transitions.items()})
+            )
+    return from_blocks(blocks)
+
+
+def iter_offline_batches(transitions: dict, batch_size: int, epochs: int,
+                         seed: int = 0, keys: tuple = TRANSITION_KEYS):
+    """Yield full-size shuffled batches for `epochs` passes over the data,
+    streamed through the data pipeline (shuffle + re-batch per epoch).
+    Ragged tails are dropped so every batch jits with one static shape."""
+    ds = transitions_dataset(transitions)
+    # Arrow tensor columns surface as float64/list — restore source dtypes.
+    dtypes = {k: np.asarray(transitions[k]).dtype for k in keys}
+    for ep in range(epochs):
+        shuffled = ds.random_shuffle(seed=seed + ep)
+        # drop_last: every batch jits with one static shape.
+        for batch in shuffled.iter_batches(batch_size=batch_size, drop_last=True):
+            yield {
+                k: np.asarray(np.asarray(batch[k]).tolist() if batch[k].dtype == object
+                              else batch[k]).astype(dtypes[k], copy=False)
+                for k in keys if k in batch
+            }
+
+
+class _PolicyCollector(TransitionCollector):
+    """Offline dataset collection on the SHARED collect loop (the gymnasium
+    autoreset invariant lives in TransitionCollector exactly once): the
+    policy is a plain callable and batches accumulate locally instead of
+    going to a buffer actor."""
+
+    def __init__(self, env_name: str, num_envs: int, policy_fn: Callable, seed: int):
+        self._init_collector(env_name, num_envs, buffer=None, seed=seed,
+                             throttle_sleep_s=0.0)
+        self._policy = policy_fn
+        self.batches: list[dict] = []
+
+    def _select_actions(self, obs):
+        return self._policy(obs.astype(np.float32))
+
+    def _push(self, batch: dict) -> bool:
+        self.batches.append(batch)
+        return False
+
+
+def collect_transitions(env_name: str, policy_fn: Callable, n_steps: int,
+                        seed: int = 0) -> dict:
+    """Roll a policy (obs [N, D] -> actions) in a vector env and return the
+    transition dict — the offline-dataset generation helper (the reference
+    generates offline datasets from rollout workers the same way)."""
+    col = _PolicyCollector(env_name, 8, policy_fn, seed)
+    n = 0
+    while n < n_steps:
+        n += col.collect(64)["steps"]
+    col.close()
+    return {
+        k: np.concatenate([b[k] for b in col.batches])[:n_steps]
+        for k in TRANSITION_KEYS
+    }
+
+
+def evaluate_policy(env_name: str, act_fn: Callable, episodes: int = 10,
+                    seed: int = 0) -> float:
+    """Mean episode return of a deterministic policy (obs [N,D] -> actions)."""
+    import gymnasium as gym
+
+    envs = gym.make_vec(env_name, num_envs=episodes, vectorization_mode="sync")
+    obs, _ = envs.reset(seed=seed)
+    done = np.zeros(episodes, bool)
+    returns = np.zeros(episodes, np.float64)
+    for _ in range(1000):
+        actions = act_fn(obs.astype(np.float32))
+        obs, rew, term, trunc, _ = envs.step(actions)
+        returns += np.where(done, 0.0, rew)
+        done |= np.logical_or(term, trunc)
+        if done.all():
+            break
+    envs.close()
+    return float(returns.mean())
+
+
+# ---------------------------------------------------------------------------
+# BC
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class BCConfig:
+    env: str = "CartPole-v1"
+    hidden: tuple = (64, 64)
+    lr: float = 1e-3
+    batch_size: int = 256
+    epochs_per_iter: int = 5
+    seed: int = 0
+
+    def build(self, transitions: dict) -> "BC":
+        return BC(self, transitions)
+
+
+class BC:
+    """Behavior cloning: supervised imitation of the dataset's actions
+    (reference: rllib/algorithms/bc — the policy loss is pure -logp of
+    logged actions; no value function, no environment).
+
+    Tune-trainable-shaped: train() runs epochs_per_iter passes over the
+    dataset through the data pipeline; evaluate() rolls the cloned policy.
+    """
+
+    def __init__(self, config: BCConfig, transitions: dict):
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = config
+        self.transitions = transitions
+        probe = gym.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        self.discrete = hasattr(probe.action_space, "n")
+        rng = np.random.default_rng(config.seed)
+        hidden_n = len(config.hidden)
+        if self.discrete:
+            n_actions = int(probe.action_space.n)
+            from ray_tpu.rl.module import init_params
+
+            self.params = {
+                k: jnp.asarray(v)
+                for k, v in init_params(rng, obs_dim, n_actions, config.hidden).items()
+                if k.startswith(("pw", "pb", "wpi", "bpi"))  # policy tower only
+            }
+
+            def logits_fn(p, obs):
+                h = obs
+                for i in range(hidden_n):
+                    h = jnp.tanh(h @ p[f"pw{i}"] + p[f"pb{i}"])
+                return h @ p["wpi"] + p["bpi"]
+
+            def loss_fn(p, batch):
+                logits = logits_fn(p, batch["obs"])
+                logp = jax.nn.log_softmax(logits)
+                nll = -jnp.take_along_axis(
+                    logp, batch["actions"][:, None].astype(jnp.int32), axis=1
+                )[:, 0]
+                return nll.mean()
+
+            self._logits_fn = logits_fn
+        else:
+            act_dim = int(np.prod(probe.action_space.shape))
+            self.act_scale = np.asarray(probe.action_space.high, np.float32).reshape(act_dim)
+            scale = jnp.asarray(self.act_scale)
+            full = sac_init_params(rng, obs_dim, act_dim, config.hidden)
+            self.params = {
+                k: jnp.asarray(v) for k, v in full.items()
+                if k.startswith(("pw", "pb", "wmu", "bmu"))  # deterministic head
+            }
+
+            def mu_fn(p, obs):
+                h = obs
+                for i in range(hidden_n):
+                    h = jnp.tanh(h @ p[f"pw{i}"] + p[f"pb{i}"])
+                return jnp.tanh(h @ p["wmu"] + p["bmu"]) * scale
+
+            def loss_fn(p, batch):
+                pred = mu_fn(p, batch["obs"])
+                return ((pred - batch["actions"]) ** 2).mean()
+
+            self._mu_fn = mu_fn
+        probe.close()
+
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def update(p, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+        self.iteration = 0
+
+    def train(self) -> dict:
+        t0 = time.perf_counter()
+        losses = []
+        keys = ("obs", "actions")
+        for batch in iter_offline_batches(
+            self.transitions, self.cfg.batch_size, self.cfg.epochs_per_iter,
+            seed=self.cfg.seed + 100 * self.iteration, keys=keys,
+        ):
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, batch
+            )
+            losses.append(float(loss))
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "bc_loss": float(np.mean(losses)) if losses else float("nan"),
+            "updates_this_iter": len(losses),
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def act(self, obs: np.ndarray) -> np.ndarray:
+        """Deterministic cloned policy (greedy argmax / mean action)."""
+        import jax
+
+        if self.discrete:
+            logits = self._logits_fn(self.params, obs)
+            return np.asarray(jax.device_get(logits)).argmax(axis=-1).astype(np.int64)
+        return np.asarray(jax.device_get(self._mu_fn(self.params, obs)))
+
+    def evaluate(self, episodes: int = 10, seed: int = 0) -> float:
+        return evaluate_policy(self.cfg.env, self.act, episodes, seed)
+
+
+# ---------------------------------------------------------------------------
+# CQL
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CQLConfig:
+    env: str = "Pendulum-v1"
+    hidden: tuple = (128, 128)
+    lr: float = 3e-4
+    batch_size: int = 256
+    updates_per_iter: int = 1000
+    gamma: float = 0.99
+    tau: float = 0.005
+    init_alpha: float = 0.2  # SAC entropy temperature (learned)
+    # CQL penalty weight + number of sampled actions for the logsumexp.
+    # 1.0 measured best on the Pendulum medium-expert mixture (5.0 is so
+    # conservative the policy never leaves the dataset's average behavior).
+    cql_alpha: float = 1.0
+    n_action_samples: int = 8
+    max_grad_norm: float = 10.0
+    seed: int = 0
+
+    def build(self, transitions: dict) -> "CQL":
+        return CQL(self, transitions)
+
+
+class CQL:
+    """Conservative Q-learning on the SAC layout (reference:
+    rllib/algorithms/cql — SACConfig subclass adding the CQL loss terms).
+
+    One jitted program per batch: twin-critic Bellman backup on DATASET
+    transitions + CQL(H) penalty pushing down logsumexp_a Q(s, a) while
+    pushing up Q(s, a_data), plus the reparameterized policy and temperature
+    updates. Entirely offline; evaluate() rolls the mean policy."""
+
+    def __init__(self, config: CQLConfig, transitions: dict):
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = config
+        self.transitions = transitions
+        probe = gym.make(config.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        act_dim = int(np.prod(probe.action_space.shape))
+        self.act_scale = np.asarray(probe.action_space.high, np.float32).reshape(act_dim)
+        probe.close()
+        rng = np.random.default_rng(config.seed)
+        params = sac_init_params(rng, obs_dim, act_dim, config.hidden)
+        hidden_n = len(config.hidden)
+        scale = jnp.asarray(self.act_scale)
+        gamma, tau = config.gamma, config.tau
+        n_samp = config.n_action_samples
+        cql_alpha = config.cql_alpha
+        target_entropy = -float(act_dim)
+
+        def policy(p, obs):
+            h = obs
+            for i in range(hidden_n):
+                h = jnp.tanh(h @ p[f"pw{i}"] + p[f"pb{i}"])
+            mu = h @ p["wmu"] + p["bmu"]
+            log_std = jnp.clip(h @ p["wls"] + p["bls"], LOG_STD_MIN, LOG_STD_MAX)
+            return mu, log_std
+
+        def q_val(p, q, obs, act):
+            h = jnp.concatenate([obs, act / scale], axis=-1)
+            for i in range(hidden_n):
+                h = jnp.tanh(h @ p[f"{q}w{i}"] + p[f"{q}b{i}"])
+            return (h @ p[f"{q}wo"] + p[f"{q}bo"])[:, 0]
+
+        def sample(p, obs, key):
+            mu, log_std = policy(p, obs)
+            std = jnp.exp(log_std)
+            u = mu + std * jax.random.normal(key, mu.shape)
+            a = jnp.tanh(u)
+            logp = (-0.5 * (((u - mu) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+            logp -= jnp.log(1 - a ** 2 + 1e-6).sum(-1)
+            return a * scale, logp
+
+        def q_tiled(p, q, obs, acts):
+            """obs [B, D], acts [B, N, A] -> [B, N]."""
+            B, N, A = acts.shape
+            obs_t = jnp.repeat(obs[:, None], N, axis=1).reshape(B * N, -1)
+            return q_val(p, q, obs_t, acts.reshape(B * N, A)).reshape(B, N)
+
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(config.lr),
+        )
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.target = {k: v.copy() for k, v in self.params.items() if k.startswith("q")}
+        self.log_alpha = jnp.log(jnp.float32(config.init_alpha))
+        self.opt_state = self.optimizer.init(self.params)
+        self.alpha_opt = optax.adam(config.lr)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+
+        def update(p, target, log_alpha, opt_state, a_opt_state, batch, key):
+            k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+            alpha = jnp.exp(log_alpha)
+            B = batch["obs"].shape[0]
+            # Soft Bellman backup through target critics (dataset actions).
+            a2, logp2 = sample(p, batch["next_obs"], k1)
+            tq = jnp.minimum(
+                q_val(target, "q1", batch["next_obs"], a2),
+                q_val(target, "q2", batch["next_obs"], a2),
+            )
+            backup = batch["rewards"] + gamma * (1 - batch["terms"]) * (tq - alpha * logp2)
+            backup = jax.lax.stop_gradient(backup)
+
+            # CQL(H) candidate actions: uniform + current-policy at s and s',
+            # with importance corrections (Kumar et al. 2020, appendix F).
+            rand_a = jax.random.uniform(
+                k3, (B, n_samp, scale.shape[0]), minval=-1.0, maxval=1.0
+            ) * scale
+            log_unif = -jnp.log(2.0) * scale.shape[0]  # density of U(-1,1)^A
+
+            def tiled_sample(obs, key):
+                obs_t = jnp.repeat(obs[:, None], n_samp, axis=1).reshape(B * n_samp, -1)
+                a, logp = sample(p, obs_t, key)
+                return (a.reshape(B, n_samp, -1),
+                        logp.reshape(B, n_samp))
+
+            pol_a, pol_logp = tiled_sample(batch["obs"], k4)
+            nxt_a, nxt_logp = tiled_sample(batch["next_obs"], k5)
+            pol_a = jax.lax.stop_gradient(pol_a)
+            nxt_a = jax.lax.stop_gradient(nxt_a)
+            pol_logp = jax.lax.stop_gradient(pol_logp)
+            nxt_logp = jax.lax.stop_gradient(nxt_logp)
+
+            def cql_term(p, q):
+                cat = jnp.concatenate(
+                    [
+                        q_tiled(p, q, batch["obs"], rand_a) - log_unif,
+                        q_tiled(p, q, batch["obs"], pol_a) - pol_logp,
+                        q_tiled(p, q, batch["obs"], nxt_a) - nxt_logp,
+                    ],
+                    axis=1,
+                )
+                lse = jax.scipy.special.logsumexp(cat, axis=1)
+                return (lse - q_val(p, q, batch["obs"], batch["actions"])).mean()
+
+            def loss_fn(p):
+                q1 = q_val(p, "q1", batch["obs"], batch["actions"])
+                q2 = q_val(p, "q2", batch["obs"], batch["actions"])
+                bellman = 0.5 * (((q1 - backup) ** 2).mean() + ((q2 - backup) ** 2).mean())
+                conservative = cql_alpha * (cql_term(p, "q1") + cql_term(p, "q2"))
+                a_new, logp = sample(p, batch["obs"], k2)
+                q_pi = jnp.minimum(
+                    q_val(jax.lax.stop_gradient(p), "q1", batch["obs"], a_new),
+                    q_val(jax.lax.stop_gradient(p), "q2", batch["obs"], a_new),
+                )
+                pi_loss = (alpha * logp - q_pi).mean()
+                return bellman + conservative + pi_loss, (bellman, conservative, logp)
+
+            (loss, (bellman, conservative, logp)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p)
+            updates, opt_state = self.optimizer.update(grads, opt_state, p)
+            p = optax.apply_updates(p, updates)
+            ent_gap = jax.lax.stop_gradient(-logp - target_entropy).mean()
+            a_updates, a_opt_state = self.alpha_opt.update(
+                jnp.exp(log_alpha) * ent_gap, a_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, a_updates)
+            target = jax.tree.map(
+                lambda t, s: (1 - tau) * t + tau * s,
+                target, {k: v for k, v in p.items() if k.startswith("q")},
+            )
+            aux = {"bellman_loss": bellman, "cql_loss": conservative,
+                   "alpha": jnp.exp(log_alpha)}
+            return p, target, log_alpha, opt_state, a_opt_state, aux
+
+        self._update = jax.jit(update, donate_argnums=(0, 1, 3, 4))
+        self._policy = policy
+        self._key = jax.random.PRNGKey(config.seed + 11)
+        self._batches = iter_offline_batches(
+            self.transitions, config.batch_size, epochs=10_000, seed=config.seed
+        )
+        self.iteration = 0
+
+    def train(self) -> dict:
+        import jax
+
+        t0 = time.perf_counter()
+        aux = {}
+        for _ in range(self.cfg.updates_per_iter):
+            batch = next(self._batches)
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.target, self.log_alpha, self.opt_state,
+             self.alpha_opt_state, aux) = self._update(
+                self.params, self.target, self.log_alpha, self.opt_state,
+                self.alpha_opt_state, batch, sub)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "bellman_loss": float(aux.get("bellman_loss", np.nan)),
+            "cql_loss": float(aux.get("cql_loss", np.nan)),
+            "alpha": float(aux.get("alpha", np.nan)),
+            "updates_this_iter": self.cfg.updates_per_iter,
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def act(self, obs: np.ndarray) -> np.ndarray:
+        """Deterministic mean policy for evaluation."""
+        import jax
+
+        mu, _ = self._policy(self.params, obs)
+        return np.tanh(np.asarray(jax.device_get(mu))) * self.act_scale
+
+    def evaluate(self, episodes: int = 10, seed: int = 0) -> float:
+        return evaluate_policy(self.cfg.env, self.act, episodes, seed)

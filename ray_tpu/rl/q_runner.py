@@ -44,8 +44,6 @@ class TransitionCollector:
     def collect(self, n_steps: int) -> dict:
         """Run n_steps vector-env steps; push valid transitions to the buffer
         actor; returns episode stats + whether the buffer throttled us."""
-        import ray_tpu as rt
-
         episode_returns: list[float] = []
         throttled = False
         obs_l, act_l, rew_l, nxt_l, term_l = [], [], [], [], []
@@ -78,15 +76,24 @@ class TransitionCollector:
                 "terms": np.concatenate(term_l),
             }
             n_pushed = len(batch["actions"])
-            reply = rt.get(self.buffer.add_batch.remote(batch), timeout=60)
-            if reply["throttle"]:
-                throttled = True
-                time.sleep(self.throttle_sleep_s)
+            throttled = self._push(batch)
         return {
             "episode_returns": episode_returns,
             "steps": n_pushed,
             "throttled": throttled,
         }
+
+    def _push(self, batch: dict) -> bool:
+        """Deliver one transition batch; returns whether collection was
+        throttled. Default: the replay-buffer actor (online pipeline);
+        offline dataset collection overrides to accumulate locally."""
+        import ray_tpu as rt
+
+        reply = rt.get(self.buffer.add_batch.remote(batch), timeout=60)
+        if reply["throttle"]:
+            time.sleep(self.throttle_sleep_s)
+            return True
+        return False
 
     def close(self) -> bool:
         self.envs.close()
