@@ -99,6 +99,19 @@ def frame_verify(tag: bytes, payload: bytes) -> bool:
     return len(tag) == _TAG_LEN and hmac.compare_digest(tag, _tag(payload))
 
 
+def derive_frame_key(token: str | bytes) -> bytes:
+    """The session token -> frame key derivation (single home: off-cluster
+    clients, e.g. serve's ProtoServeClient, must produce byte-identical
+    tags to this process's set_auth_token path)."""
+    raw = token.encode() if isinstance(token, str) else bytes(token)
+    return hashlib.blake2b(raw, digest_size=32, person=b"raytpu-rpc").digest()
+
+
+def tag_with_key(key: bytes, payload: bytes) -> bytes:
+    """frame_tag with an explicit key (off-cluster callers)."""
+    return hashlib.blake2b(payload, key=key, digest_size=_TAG_LEN).digest()
+
+
 FRAME_TAG_LEN = _TAG_LEN
 
 

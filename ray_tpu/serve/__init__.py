@@ -26,6 +26,7 @@ from ray_tpu.serve.handle import (
     DeploymentResponseGenerator,
 )
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.proto_client import ProtoServeClient, ProtoServeError
 from ray_tpu.serve.proxy import HTTPResponse, Request
 
 __all__ = [
@@ -37,6 +38,8 @@ __all__ = [
     "DeploymentResponse",
     "DeploymentResponseGenerator",
     "HTTPResponse",
+    "ProtoServeClient",
+    "ProtoServeError",
     "Request",
     "batch",
     "delete",

@@ -86,11 +86,16 @@ class ProxyActor:
 
     Two ingress protocols (reference: HTTPProxy proxy.py:710 + gRPCProxy
     proxy.py:534): HTTP/1.1 on `port`, and a length-prefixed binary RPC
-    protocol on `rpc_port` — frame = 4-byte LE length + pickled
-    (app, deployment, method, args, kwargs); reply = 4-byte LE length +
-    pickled ("ok", result) | ("err", message). The binary path skips HTTP
-    parsing and JSON for structured in-datacenter callers, which is the
-    role gRPC ingress plays in the reference."""
+    protocol on `rpc_port` speaking TWO payload formats, distinguished by
+    a leading magic:
+    - "PB1\\0" + protobuf ServeRequest (serve/protocol/serve_rpc.proto):
+      the POLYGLOT surface — any language codegens the schema and speaks
+      JSON-in-protobuf over a socket; this is the role the reference's
+      gRPC proxy plays. Reply: "PB1\\0" + ServeReply.
+    - otherwise pickled (app, deployment, method, args, kwargs) for
+      trusted in-datacenter Python callers; reply = pickled
+      ("ok", result) | ("err", message).
+    Both ride the same per-frame session-HMAC auth."""
 
     ROUTE_TTL_S = 1.0
 
@@ -158,7 +163,40 @@ class ProxyActor:
 
                 def run(frame=frame):
                     from ray_tpu.serve.handle import DeploymentHandle
+                    from ray_tpu.serve.protocol import PROTO_MAGIC
 
+                    if frame.startswith(PROTO_MAGIC):
+                        # Polyglot protobuf surface: JSON args in, JSON
+                        # result out — pickle never touches these frames.
+                        # (pb2 imported lazily inside the branch: the pickle
+                        # path must keep working without google.protobuf.)
+                        from ray_tpu.serve.protocol import pb2
+
+                        pb = pb2()
+                        reply = pb.ServeReply()
+                        try:
+                            req = pb.ServeRequest()
+                            req.ParseFromString(frame[len(PROTO_MAGIC):])
+                            payload = json.loads(req.json_payload or b"{}")
+                            handle = DeploymentHandle(
+                                req.deployment, req.app, req.method or "__call__"
+                            )
+                            if req.affinity_key:
+                                handle = handle.options(affinity_key=req.affinity_key)
+                            # Client-controlled timeout, CAPPED: the dispatch
+                            # pool is shared with HTTP/health routing — an
+                            # unbounded .result() would let one caller pin
+                            # its threads indefinitely.
+                            timeout = min(req.timeout_s or 60.0, 600.0)
+                            result = handle.remote(
+                                *payload.get("args", []), **payload.get("kwargs", {})
+                            ).result(timeout=timeout)
+                            reply.status = pb.ServeReply.OK
+                            reply.json_result = json.dumps(result).encode()
+                        except Exception as e:  # noqa: BLE001 — serialized to the client
+                            reply.status = pb.ServeReply.ERROR
+                            reply.error = f"{type(e).__name__}: {e}"
+                        return PROTO_MAGIC + reply.SerializeToString()
                     try:
                         app, deployment, method, args, kwargs = pickle.loads(frame)
                         handle = DeploymentHandle(deployment, app, method or "__call__")
