@@ -13,10 +13,20 @@ Role-equivalent to the reference's RLlib core split (rllib/):
     learning (IMPALA-shaped pipeline), double-Q target network, PER
     importance weights;
 - Offline RL (algorithms/{bc,cql}/) -> rl/offline.py: BC + CQL trained
-  from saved transition datasets streamed through ray_tpu.data.
+  from saved transition datasets streamed through ray_tpu.data;
+- Multi-agent (env/multi_agent_env.py + multi_agent_env_runner.py) ->
+  rl/multi_agent.py: per-agent dict env ABC, per-policy runner batching,
+  independent PPO with policy_mapping_fn routing.
 """
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.multi_agent import (
+    CueMatchEnv,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.offline import (
     BC,
@@ -42,8 +52,13 @@ __all__ = [
     "CQLConfig",
     "DQN",
     "DQNConfig",
+    "CueMatchEnv",
     "IMPALA",
     "IMPALAConfig",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "PPO",
     "PPOConfig",
     "SAC",
