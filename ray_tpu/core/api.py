@@ -97,6 +97,28 @@ def _write_session_token_file(address: str, token: str) -> str | None:
 # the shared session token out from under another Cluster that inherited it
 # (both would be using the same process-global Config + rpc key).
 _LIVE_CLUSTERS: list = []
+# Every token ever auto-minted by THIS process (bounded: one per in-process
+# cluster). Cluster bring-up and init(address=...) refuse to authenticate
+# with one of these unless a live cluster still owns it — defense in depth
+# over the shutdown scrub: no leak path can make a driver reuse a dead
+# session's secret against a fresh cluster.
+_MINTED_HISTORY: set = set()
+
+
+def _token_owned_by_live_cluster(token: str) -> bool:
+    return any(c.config.auth_token == token for c in _LIVE_CLUSTERS)
+
+
+def _drop_stale_minted_token(cfg) -> None:
+    """Single home for the stale-mint predicate (used by Cluster bring-up
+    AND the address-connect path): a token this process auto-minted whose
+    session is gone must never authenticate anything new."""
+    if (
+        cfg.auth_token
+        and cfg.auth_token in _MINTED_HISTORY
+        and not _token_owned_by_live_cluster(cfg.auth_token)
+    ):
+        cfg.auth_token = ""
 
 
 class Cluster:
@@ -105,6 +127,10 @@ class Cluster:
     def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None,
                  config: Config | None = None, persist_path: str | None = None):
         self.config = config or get_config()
+        # A DEAD in-process session's auto-minted secret may have survived
+        # in this (shared) Config (a skipped scrub). Never build a new
+        # cluster on a dead session's key: drop it so a fresh one mints.
+        _drop_stale_minted_token(self.config)
         if not self.config.auth_token and os.environ.get("RAYTPU_AUTO_TOKEN", "1") != "0":
             # Auto-generated per-session RPC secret (reference: required auth
             # infrastructure, src/ray/rpc/authentication): the head mints a
@@ -116,6 +142,7 @@ class Cluster:
             import secrets
 
             self.config.auth_token = secrets.token_hex(16)
+            _MINTED_HISTORY.add(self.config.auth_token)
             # Minted into a (possibly process-global) Config: remember to
             # scrub it on shutdown, or the NEXT session in this process
             # inherits a dead cluster's token and fails every MAC check
@@ -191,44 +218,54 @@ class Cluster:
         self.host.call(daemon.stop())
 
     def shutdown(self):
-        for d in list(self.daemons):
+        # The teardown steps can raise under load (hung daemon joins, dead
+        # controller handles); the token scrub in the finally must run
+        # regardless — a skipped scrub leaks this session's minted secret
+        # into the process-global Config and every later init(address=...)
+        # fails its MAC checks (the order-sensitive start-CLI flake).
+        try:
+            for d in list(self.daemons):
+                try:
+                    self.host.call(d.stop())
+                except Exception:
+                    pass
+            self.daemons.clear()
             try:
-                self.host.call(d.stop())
+                self.host.call(self.controller.stop())
             except Exception:
                 pass
-        self.daemons.clear()
-        try:
-            self.host.call(self.controller.stop())
-        except Exception:
-            pass
-        self.host.stop()
-        if self._token_file:
-            try:
-                os.unlink(self._token_file)
-            except OSError:
-                pass
-            self._token_file = None
-        if self in _LIVE_CLUSTERS:
-            _LIVE_CLUSTERS.remove(self)
-        if self._minted_token and _LIVE_CLUSTERS:
-            # A later-created Cluster inherited this token; hand the scrub
-            # duty to it so the LAST sharer cleans up.
-            _LIVE_CLUSTERS[0]._minted_token = True
-            self._minted_token = False
-        if self._minted_token and not _LIVE_CLUSTERS:
-            # Restore whatever the environment pins (usually ""): a later
-            # init(address=...) in this process must fall through to the
-            # session-token-file / RAYTPU_AUTH_TOKEN discovery path instead
-            # of reusing this dead session's secret. Scrub the rpc-module
-            # copy too — the direct-Cluster path (no api.shutdown) must not
-            # keep MAC-tagging frames with the dead secret. Skipped while
-            # another live Cluster in this process shares the token.
-            from ray_tpu.core import rpc as _rpc
+            self.host.stop()
+        finally:
+            if self._token_file:
+                # In the finally: a raising teardown must not leave the
+                # dead session's secret file at its predictable path (a
+                # later driver would discover the dead token from it).
+                try:
+                    os.unlink(self._token_file)
+                except OSError:
+                    pass
+                self._token_file = None
+            if self in _LIVE_CLUSTERS:
+                _LIVE_CLUSTERS.remove(self)
+            if self._minted_token and _LIVE_CLUSTERS:
+                # A later-created Cluster inherited this token; hand the scrub
+                # duty to it so the LAST sharer cleans up.
+                _LIVE_CLUSTERS[0]._minted_token = True
+                self._minted_token = False
+            if self._minted_token and not _LIVE_CLUSTERS:
+                # Restore whatever the environment pins (usually ""): a later
+                # init(address=...) in this process must fall through to the
+                # session-token-file / RAYTPU_AUTH_TOKEN discovery path instead
+                # of reusing this dead session's secret. Scrub the rpc-module
+                # copy too — the direct-Cluster path (no api.shutdown) must not
+                # keep MAC-tagging frames with the dead secret. Skipped while
+                # another live Cluster in this process shares the token.
+                from ray_tpu.core import rpc as _rpc
 
-            self.config.auth_token = type(self.config)().apply_env().auth_token
-            if not self.config.auth_token:
-                _rpc.set_auth_token(None)
-            self._minted_token = False
+                self.config.auth_token = type(self.config)().apply_env().auth_token
+                if not self.config.auth_token:
+                    _rpc.set_auth_token(None)
+                self._minted_token = False
 
 
 def init(
@@ -254,6 +291,11 @@ def init(
     cfg = config or get_config()
     if node_ip:
         cfg.node_ip = node_ip
+    if address is not None:
+        # Stale auto-minted secret from a dead in-process session (a scrub
+        # was skipped somewhere): connecting to an external cluster with it
+        # would fail every MAC check. Drop it and rediscover below.
+        _drop_stale_minted_token(cfg)
     if not cfg.auth_token and address is not None:
         # Same-host driver joining an auto-tokened cluster: pick the session
         # token up from the head's token file (multi-host joins pass
@@ -320,17 +362,39 @@ def init_cluster(cluster: Cluster) -> dict:
 
 def shutdown():
     global _global_worker, _global_cluster
-    if _global_worker is not None:
-        _global_worker.shutdown_sync()
+    try:
+        if _global_worker is not None:
+            _global_worker.shutdown_sync()
+    finally:
+        # A raising worker teardown must not skip the cluster shutdown (and
+        # with it the minted-token scrub) — that exact skip leaked session
+        # secrets into later inits at full-suite load. Nested finally: a
+        # raising CLUSTER teardown must equally not skip the config/rpc
+        # restore below.
         _global_worker = None
-    if _global_cluster is not None:
-        _global_cluster.shutdown()
-        _global_cluster = None
-    # The session token must not leak into a later session in this process
-    # (an authed stale key makes a fresh unauthed cluster unparseable).
-    from ray_tpu.core import rpc as _rpc
+        try:
+            if _global_cluster is not None:
+                _global_cluster.shutdown()
+        finally:
+            _global_cluster = None
+            # The session token must not leak into a later session in this
+            # process, whether it was MINTED by an in-process cluster
+            # (scrubbed above) or DISCOVERED by an address-connected driver
+            # (session token file / head handshake wrote it into the global
+            # Config): restore whatever the environment pins (usually
+            # empty) and drop the rpc module's key. EXCEPTION: a still-live
+            # direct Cluster sharing the token keeps it — detaching a
+            # driver must not pull the key out from under a serving
+            # cluster's workers.
+            from ray_tpu.core import rpc as _rpc
 
-    _rpc.set_auth_token(None)
+            cfg = get_config()
+            if not (cfg.auth_token and _token_owned_by_live_cluster(cfg.auth_token)):
+                cfg.auth_token = type(cfg)().apply_env().auth_token
+                if cfg.auth_token:
+                    _rpc.set_auth_token(cfg.auth_token)
+                else:
+                    _rpc.set_auth_token(None)
 
 
 def is_initialized() -> bool:

@@ -68,18 +68,47 @@ def test_minted_token_scrubbed_on_shutdown():
     assert not get_config().auth_token, "stale session token leaked into global config"
 
 
+def test_stale_minted_token_dropped_on_head_init():
+    """Defense in depth for the suite-scale leak, HEAD-init side: even if
+    some teardown DID leave a dead session's auto-minted token in the
+    global config (skipped scrub), a new in-process cluster must drop it
+    and mint fresh. (The address-connect side of the same guard is
+    exercised by test_start_cli_two_process_cluster, which deliberately
+    seeds a stale mint before rt.init(address=...).)"""
+    from ray_tpu.core import api
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    assert not cfg.auth_token
+    try:
+        cfg.auth_token = "deadbeef" * 4
+        api._MINTED_HISTORY.add(cfg.auth_token)  # simulate a leaked mint
+        import ray_tpu as rt
+
+        rt.init(num_cpus=1)  # head init re-mints fresh (stale token dropped?)
+        rt.shutdown()
+        assert not get_config().auth_token
+    finally:
+        cfg.auth_token = ""
+
+
 def test_start_cli_two_process_cluster(cli_cluster):
     addr, env = cli_cluster
     import ray_tpu as rt
     from ray_tpu.core import api
+    from ray_tpu.core.config import get_config
 
     # The round-4 flake fired only when OTHER tests' sessions ran first in
     # this process: reproduce that deliberately with a throwaway in-process
-    # session before connecting to the CLI-started cluster.
+    # session before connecting to the CLI-started cluster, AND with a
+    # deliberately-leaked stale minted token (the suite-scale failure mode:
+    # some earlier teardown skipped its scrub).
     rt.init(num_cpus=1)
     rt.shutdown()
+    get_config().auth_token = "feedface" * 4
+    api._MINTED_HISTORY.add(get_config().auth_token)
 
-    rt.init(address=addr)  # token from RAYTPU_AUTH_TOKEN (multi-host path)
+    rt.init(address=addr)  # must rediscover via the session token file
     try:
         # Both standalone daemons registered.
         deadline = time.time() + 60
