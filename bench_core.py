@@ -105,27 +105,44 @@ def bench_actor_sync(n):
     report("1_1_actor_calls_sync", n, timed(run, n))
 
 
+def _wire_batch_hist():
+    """Driver-side envelope-size distribution for the measured window
+    (send = submission coalescing, recv = reply coalescing), JSON-keyed."""
+    from ray_tpu.core import rpc
+
+    st = rpc.batch_stats()
+    return {side: {str(k): v for k, v in h.items()} for side, h in st.items()}
+
+
 def bench_actor_async(n):
+    from ray_tpu.core import rpc
+
     a = Sink.remote()
     rt.get(a.ping.remote(), timeout=60)
 
     def run(k):
+        rpc.batch_stats(reset=True)
         rt.get([a.ping.remote() for _ in range(k)], timeout=120)
 
-    report("1_1_actor_calls_async", n, timed(run, n))
+    report("1_1_actor_calls_async", n, timed(run, n),
+           detail={"wire_batches": _wire_batch_hist()})
 
 
 def bench_actor_nn_async(n):
+    from ray_tpu.core import rpc
+
     actors = [Sink.remote() for _ in range(4)]
     rt.get([a.ping.remote() for a in actors], timeout=60)
 
     def run(k):
+        rpc.batch_stats(reset=True)
         refs = [actors[i % len(actors)].ping.remote() for i in range(k)]
         rt.get(refs, timeout=120)
 
     report(
         "n_n_actor_calls_async", n, timed(run, n),
         detail={
+            "wire_batches": _wire_batch_hist(),
             "host_cores": os.cpu_count(),
             "note": "baseline's n:n row runs n client processes against n server "
                     "actors spread over 64 cores (m5.16xlarge); here 1 driver + 4 "

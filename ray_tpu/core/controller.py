@@ -333,15 +333,15 @@ class Controller:
             if conn.closed:
                 dead.append(conn)
                 continue
-            asyncio.create_task(self._safe_notify(conn, channel, key, data))
+            try:
+                # Fire-and-forget enqueue: a publish storm (log lines, task
+                # events) coalesces into one envelope per subscriber per
+                # loop tick instead of one frame + one task per event.
+                conn.notify_soon("pub", {"channel": channel, "key": key, "data": data})
+            except Exception:
+                dead.append(conn)
         for c in dead:
             self.subscribers[channel].discard(c)
-
-    async def _safe_notify(self, conn, channel, key, data):
-        try:
-            await conn.notify("pub", {"channel": channel, "key": key, "data": data})
-        except Exception:
-            pass
 
     # -- connection lifecycle ------------------------------------------
     def on_connection(self, conn):

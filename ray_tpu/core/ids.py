@@ -29,6 +29,14 @@ def _fresh_entropy():
     _prefix = os.urandom(24)
     _counter = itertools.count(int.from_bytes(os.urandom(8), "little"))
 
+
+# Fork guard without a per-mint getpid() syscall (from_random runs per task
+# submission): reseed the child's prefix/counter at fork time. Non-fork
+# process creation (spawn/exec) re-imports this module and starts fresh, so
+# the hook covers every path to a duplicated prefix.
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_fresh_entropy)
+
 _KIND_SIZES = {
     "JobID": 4,
     "NodeID": 16,
@@ -117,8 +125,10 @@ class TaskID(BaseID):
     @classmethod
     def from_random(cls):
         # Hot path (every task submission). 8-byte process prefix + counter;
-        # truncated TaskID uses are logging-only, so the shared prefix is safe.
-        if os.getpid() != _pid:
+        # truncated TaskID uses are logging-only, so the shared prefix is
+        # safe. Fork staleness is handled by the at-fork reseed hook above —
+        # no per-mint getpid().
+        if not _prefix:
             _fresh_entropy()
         n = next(_counter) & 0xFFFFFFFFFFFFFFFF
         return cls(_prefix[:8] + n.to_bytes(8, "little"))
@@ -139,7 +149,7 @@ class ObjectID(BaseID):
 
     @classmethod
     def from_put(cls):
-        if os.getpid() != _pid:
+        if not _prefix:  # fork staleness: at-fork reseed hook (see above)
             _fresh_entropy()
         n = next(_counter) & 0xFFFFFFFFFFFFFFFF
         return cls(_prefix[:8] + n.to_bytes(8, "little") + (2**32 - 1).to_bytes(4, "little"))

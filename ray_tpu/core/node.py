@@ -221,12 +221,27 @@ class NodeDaemon:
             env_vars, pypath, cwd, python_exe, container = await _re.materialize(
                 renv, cache_root, kv_get
             )
+        except _re.RuntimeEnvSetupError:
+            # Deterministic failure: submitters see the TYPE across the RPC
+            # hop (worker.py _request_lease isinstance-checks it), classify
+            # it PERMANENT for the task key, and fail the task instead of
+            # retrying the lease forever — a missing conda env or failed
+            # build fails identically every try.
+            raise
+        except (rpc.ConnectionLost, ConnectionError,
+                asyncio.TimeoutError, TimeoutError):
+            # Transient control-plane fault mid-materialization (kv_get
+            # hiccup, controller restart): propagate as-is so the
+            # submitter's lease retry path gets another attempt. NOT the
+            # broader RpcError: a controller HANDLER error repeats
+            # identically per attempt — that's the permanent bucket below.
+            raise
         except Exception as e:
-            # Uniform marker: submitters classify "runtime_env" errors as
-            # PERMANENT for the task key and fail the task instead of
-            # retrying the lease forever (worker.py _request_lease) — a
-            # missing conda env or failed build fails identically every try.
-            raise RuntimeError(f"runtime_env setup failed: {e}") from e
+            # Everything else is deterministic for this spec (corrupt
+            # package zip, extract/filesystem errors, bad spec content) —
+            # the same bytes fail the same way on every retry. Permanent by
+            # default; only the known-transient set above retries.
+            raise _re.RuntimeEnvSetupError(f"runtime_env setup failed: {e}") from e
         return env_vars, pypath, cwd, renv.get("hash", ""), python_exe, container
 
     def _spawn_worker(self, env_overrides: dict | None = None, pypath: list | None = None,

@@ -12,8 +12,22 @@ Wire format: 8-byte little-endian length, then 1 version byte
 speaking a different generation is REFUSED with a clear log line before any
 byte of it reaches pickle, so two mixed-version hosts fail loud instead of
 corrupting each other mid-rolling-upgrade), then [16-byte session tag when a
-token is set] + pickle of (kind, msg_id, method_or_status, payload).
+token is set] + pickle of EITHER one (kind, msg_id, method_or_status,
+payload) message tuple OR a list of such tuples (a coalesced envelope).
 kind: 0=request, 1=reply, 2=notify (no reply expected).
+
+Adaptive frame coalescing (the async actor-call hot path): every send
+lands in a per-connection buffer that is flushed once per event-loop tick
+(a ``call_soon`` callback — never a timer), so N messages enqueued within
+one tick ship as ONE envelope paying one length header, one version byte,
+one keyed-BLAKE2b tag, one ``pickle.dumps`` (whose memo also interns
+constants — method-name strings, shared options objects — once per batch
+instead of once per call), one socket write, and one reader wakeup. A lone
+message flushes at the tail of the same tick: sync-call latency gains one
+sub-tick callback hop, never a timer delay. The batch is adaptive purely by
+queue depth — only what is ALREADY pending coalesces (reference inspiration:
+the paper's L0/L3 submission queues over a batched RPC plane, and T3-style
+overlap of transport with compute).
 
 Authentication (ON BY DEFAULT): pickle-over-TCP executes arbitrary code on
 unpickle, so a session token is installed for every cluster (auto-minted at
@@ -31,6 +45,7 @@ but cannot forge new payloads.
 from __future__ import annotations
 
 import asyncio
+import collections
 import hashlib
 import hmac
 import itertools
@@ -51,12 +66,19 @@ _TAG_LEN = 16
 # reference schema evolution for free; pickle frames get a refuse-on-mismatch
 # version byte instead. Chosen != 0x80 (pickle PROTO opcode) so pre-version
 # builds are also rejected, not misparsed.
-WIRE_VERSION = 1
+# v2: payload may be a LIST of message tuples (coalesced envelope) instead
+# of a single tuple; a v1 build would misdispatch a list, so fail loud.
+WIRE_VERSION = 2
 _VER = bytes([WIRE_VERSION])
 # Sanity cap on a declared frame length: readexactly buffers the whole frame
 # BEFORE the auth check can reject the peer, so an untrusted header must not
 # be able to demand unbounded memory.
 _MAX_FRAME = 1 << 30
+# Coalesced envelopes larger than this split back into one frame per message
+# (individually-fine messages must never combine into a frame the receiver's
+# _MAX_FRAME cap rejects). Comfortably under _MAX_FRAME with margin for the
+# biggest sane inline payloads.
+_SPLIT_BYTES = 32 << 20
 
 _frame_key: bytes = b""  # empty = auth disabled
 
@@ -114,6 +136,25 @@ def tag_with_key(key: bytes, payload: bytes) -> bytes:
 
 FRAME_TAG_LEN = _TAG_LEN
 
+# Process-wide envelope-size histograms ({messages-per-envelope: envelopes}),
+# send and receive sides, across every Connection in this process. Cheap
+# enough to keep always-on; bench_core.py reports them in row `detail`.
+_SEND_BATCH_HIST: collections.Counter = collections.Counter()
+_RECV_BATCH_HIST: collections.Counter = collections.Counter()
+
+
+def batch_stats(reset: bool = False) -> dict:
+    """Envelope-size distribution observed by this process:
+    {"send": {batch_size: count}, "recv": {batch_size: count}}."""
+    out = {
+        "send": {k: v for k, v in sorted(_SEND_BATCH_HIST.items())},
+        "recv": {k: v for k, v in sorted(_RECV_BATCH_HIST.items())},
+    }
+    if reset:
+        _SEND_BATCH_HIST.clear()
+        _RECV_BATCH_HIST.clear()
+    return out
+
 
 class RpcError(Exception):
     pass
@@ -142,22 +183,105 @@ class Connection:
         self._ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self._closed = False
-        self._send_lock = asyncio.Lock()
+        self._send_lock = asyncio.Lock()  # serializes drain() waiters only
+        # Coalescing buffer: messages enqueued this loop tick; flushed as one
+        # envelope by a call_soon callback (see module docstring).
+        self._out: list[tuple] = []
+        self._flush_scheduled = False
         self._task = asyncio.create_task(self._read_loop())
         self.on_close = None  # optional callback
         self.meta: dict = {}  # server-side per-connection state (registration info)
 
-    async def _send(self, frame: tuple):
-        data = pickle.dumps(frame, protocol=5)
+    def _enqueue(self, msg: tuple):
+        """Queue one message; the per-tick flush callback ships everything
+        queued since the last flush as a single envelope. Enqueue order ==
+        envelope order == wire order."""
+        self._out.append(msg)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush_out)
+
+    def _flush_out(self):
+        """Encode + write everything pending as ONE wire frame: one pickle
+        of the message list (single message: the bare tuple — no list
+        wrapper cost for the lone-frame case), one MAC, one write."""
+        self._flush_scheduled = False
+        if self._closed or not self._out:
+            self._out.clear()
+            return
+        msgs = self._out
+        self._out = []
+        payload = msgs[0] if len(msgs) == 1 else msgs
+        try:
+            data = pickle.dumps(payload, protocol=5)
+        except Exception:
+            # A failing payload anywhere in the batch (unpicklable value, or
+            # MemoryError on the combined dump) must not sink its batchmates
+            # — pre-coalescing, pickling was per-message at the call site
+            # and failed only that message. Salvage per-message; no second
+            # combined dump that could fail the same way.
+            for frame in self._salvage_unpicklable(msgs):
+                self._write_frame(frame)
+                _SEND_BATCH_HIST[1] += 1
+            return
+        if len(data) > _SPLIT_BYTES and len(msgs) > 1:
+            # A combined envelope could exceed the receiver's _MAX_FRAME cap
+            # even when each message is individually fine: fall back to one
+            # frame per message (the pre-coalescing wire shape).
+            for m in msgs:
+                self._write_frame(pickle.dumps(m, protocol=5))
+                _SEND_BATCH_HIST[1] += 1
+            return
+        self._write_frame(data)
+        _SEND_BATCH_HIST[len(msgs)] += 1
+
+    def _write_frame(self, data: bytes):
         data = _VER + _tag(data) + data if _frame_key else _VER + data
-        async with self._send_lock:
+        try:
             self.writer.write(len(data).to_bytes(_HDR, "little") + data)
+        except Exception:
+            pass  # transport gone: the read loop tears the connection down
+
+    def _salvage_unpicklable(self, msgs: list) -> list:
+        """Per-message encoded frames for a batch whose combined pickle
+        failed. Messages that pickle alone survive verbatim; an unpicklable
+        reply becomes an 'err' reply (what the pre-batching _dispatch
+        produced); an unpicklable request fails its own local reply future;
+        a notify is logged and dropped."""
+        frames = []
+        for m in msgs:
+            try:
+                frames.append(pickle.dumps(m, protocol=5))
+                continue
+            except Exception as e:
+                err = RpcError(f"unpicklable rpc payload ({type(e).__name__}: {e})")
+            kind, msg_id = m[0], m[1]
+            logger.warning("dropping unpicklable %s frame to %s: %s",
+                           ("request", "reply", "notify")[kind], self.peer_name, err)
+            if kind == _REP:
+                frames.append(pickle.dumps((_REP, msg_id, "err", err), protocol=5))
+            elif kind == _REQ:
+                fut = self._pending.get(msg_id)
+                if fut is not None and not fut.done():
+                    fut.set_exception(err)
+        return frames
+
+    async def _send(self, frame: tuple):
+        self._enqueue(frame)
+        # Yield exactly one loop turn: the flush callback (scheduled by this
+        # tick's first enqueue, hence ahead of our resumption in the ready
+        # queue) runs before we proceed, so the frame is on the transport
+        # when drain() returns. Replies/notifies produced by OTHER tasks in
+        # the same tick ride the same envelope — this is what batches reply
+        # absorption without ever delaying a lone frame behind a timer.
+        await asyncio.sleep(0)
+        async with self._send_lock:
             await self.writer.drain()
 
     def call_start(self, method: str, payload: Any = None) -> "asyncio.Future":
         """Synchronously enqueue a request frame; return the reply future.
 
-        Unlike ``call``, the frame hits the transport buffer before this
+        Unlike ``call``, the message joins the outbound envelope before this
         returns, so invocation order == wire order — required by per-actor
         FIFO task submission (the reference orders actor tasks with sequence
         numbers in ActorTaskSubmitter; here wire order is the sequence).
@@ -168,13 +292,24 @@ class Connection:
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
         fut.add_done_callback(lambda f: self._pending.pop(msg_id, None))
-        data = pickle.dumps((_REQ, msg_id, method, payload), protocol=5)
-        data = _VER + _tag(data) + data if _frame_key else _VER + data
-        self.writer.write(len(data).to_bytes(_HDR, "little") + data)
+        self._enqueue((_REQ, msg_id, method, payload))
         return fut
 
+    def notify_soon(self, method: str, payload: Any = None):
+        """Fire-and-forget notify with NO coroutine and NO backpressure:
+        enqueue onto the coalescing buffer and return. For fan-out bursts
+        (pubsub publish) where a per-event task is pure overhead; callers
+        that need transport backpressure use ``notify``."""
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.peer_name} closed")
+        self._enqueue((_NOTIFY, 0, method, payload))
+
     async def flush(self):
-        """Await transport drain — backpressure for call_start senders."""
+        """Flush the coalescing buffer now and await transport drain —
+        backpressure for call_start senders (one flush per submission
+        burst = one envelope per burst)."""
+        if self._out and not self._closed:
+            self._flush_out()
         async with self._send_lock:
             await self.writer.drain()
 
@@ -223,17 +358,27 @@ class Connection:
                         logger.warning("rejecting unauthenticated rpc frame from %s", self.peer_name)
                         return
                     data = body
-                kind, msg_id, method, payload = pickle.loads(data)
-                if kind == _REP:
-                    fut = self._pending.get(msg_id)
-                    if fut is not None and not fut.done():
-                        ok, result = method, payload
-                        if ok == "ok":
-                            fut.set_result(result)
-                        else:
-                            fut.set_exception(result if isinstance(result, BaseException) else RpcError(str(result)))
-                else:
-                    asyncio.create_task(self._dispatch(kind, msg_id, method, payload))
+                obj = pickle.loads(data)
+                # Envelope decode: one frame carries either a single message
+                # tuple or a list of them (coalesced batch). All replies in
+                # a batch resolve inline in THIS wakeup — reply absorption
+                # is amortized to one loop wakeup per envelope; requests/
+                # notifies dispatch as tasks in wire order (ordering contract
+                # for per-actor FIFO and stream registration is task-creation
+                # order, which equals envelope order).
+                msgs = obj if type(obj) is list else (obj,)
+                _RECV_BATCH_HIST[len(msgs)] += 1
+                for kind, msg_id, method, payload in msgs:
+                    if kind == _REP:
+                        fut = self._pending.get(msg_id)
+                        if fut is not None and not fut.done():
+                            ok, result = method, payload
+                            if ok == "ok":
+                                fut.set_result(result)
+                            else:
+                                fut.set_exception(result if isinstance(result, BaseException) else RpcError(str(result)))
+                    else:
+                        asyncio.create_task(self._dispatch(kind, msg_id, method, payload))
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
             pass
         except asyncio.CancelledError:
@@ -252,7 +397,15 @@ class Connection:
             if asyncio.iscoroutine(result):
                 result = await result
             if kind == _REQ:
-                await self._send((_REP, msg_id, "ok", result))
+                # Reply fast path: enqueue only — reply volume is bounded by
+                # the peer's in-flight requests, so per-reply drain is pure
+                # overhead, and skipping it lets every reply completing this
+                # tick coalesce into one envelope. Drain (backpressure) only
+                # when the transport buffer is genuinely backed up.
+                self._enqueue((_REP, msg_id, "ok", result))
+                if self.writer.transport.get_write_buffer_size() > 1 << 20:
+                    async with self._send_lock:
+                        await self.writer.drain()
         except asyncio.CancelledError:
             raise
         except BaseException as e:
@@ -273,6 +426,7 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        self._out.clear()  # unflushed messages die with their reply futures
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost(f"connection to {self.peer_name} lost"))

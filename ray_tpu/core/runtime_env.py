@@ -45,6 +45,21 @@ _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 MAX_PKG_BYTES = 256 * 1024 * 1024
 
 
+class RuntimeEnvSetupError(RuntimeError):
+    """A DETERMINISTIC runtime-env materialization failure: the same spec
+    will fail identically on every retry (missing conda/container binary,
+    failed pip/conda env build, package absent from the cluster KV, invalid
+    spec). Submitters treat it as PERMANENT for the task's scheduling key
+    and fail the queued tasks instead of retrying the lease forever.
+
+    Transient faults (a kv_get RPC hiccup mid-download, a controller
+    restart) must NOT be raised as this type — they propagate as-is and the
+    lease request retries. Picklable with its message, so the distinction
+    survives the daemon->submitter RPC hop (worker.py checks isinstance,
+    not message substrings).
+    """
+
+
 def _zip_dir(path: str) -> bytes:
     """Deterministic zip: sorted walk order + fixed timestamps, so identical
     directory CONTENTS always produce identical bytes (the content-addressed
@@ -170,7 +185,7 @@ async def _fetch_pkg(uri: str, cache_root: str, kv_get) -> str:
     if not os.path.isdir(dest):
         data = await kv_get(uri)
         if data is None:
-            raise RuntimeError(f"runtime_env package {uri} missing from the cluster KV")
+            raise RuntimeEnvSetupError(f"runtime_env package {uri} missing from the cluster KV")
 
         def extract():  # off the event loop: large zips must not stall the daemon
             tmp = f"{dest}.tmp{os.getpid()}"
@@ -255,7 +270,7 @@ async def _build_venv(spec: dict, cache_root: str, kv_get) -> str:
             import shutil
 
             shutil.rmtree(tmp, ignore_errors=True)
-            raise RuntimeError(
+            raise RuntimeEnvSetupError(
                 f"pip install failed for runtime_env {spec.get('hash')}:\n{proc.stderr[-2000:]}"
             )
         os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
@@ -321,7 +336,7 @@ async def _resolve_conda(spec: dict, cache_root: str) -> str:
     conda = spec["conda"]
     exe = _conda_exe()
     if exe is None:
-        raise RuntimeError(
+        raise RuntimeEnvSetupError(
             "runtime_env requests a conda env but no conda binary is available "
             "on this node (install conda or set RAYTPU_CONDA_EXE)"
         )
@@ -333,7 +348,7 @@ async def _resolve_conda(spec: dict, cache_root: str) -> str:
             py = (os.path.join(out, "bin", "python") if conda == "base"
                   else os.path.join(out, "envs", conda, "bin", "python"))
             if not os.path.exists(py):
-                raise RuntimeError(f"conda env {conda!r} not found ({py} missing)")
+                raise RuntimeEnvSetupError(f"conda env {conda!r} not found ({py} missing)")
             return py
 
         return await loop.run_in_executor(None, resolve_named)
@@ -360,7 +375,7 @@ async def _resolve_conda(spec: dict, cache_root: str) -> str:
         os.unlink(yml)
         if proc.returncode != 0:
             shutil.rmtree(tmp, ignore_errors=True)
-            raise RuntimeError(
+            raise RuntimeEnvSetupError(
                 f"conda env create failed for runtime_env {spec.get('hash')}:\n"
                 f"{proc.stderr[-2000:]}"
             )
@@ -395,6 +410,14 @@ def _container_engine() -> str | None:
 # coordinates + interpreter config; everything else stays host-side).
 _CONTAINER_ENV_PREFIXES = ("RAYTPU_", "PYTHON", "JAX_", "XLA_", "TPU_")
 
+# Secret-bearing vars are forwarded as VALUE-LESS `--env K` flags: podman and
+# docker then inherit the value from the engine client's own environment
+# (which Popen receives via env=), so the session MAC secret never appears on
+# the engine command line (world-readable via /proc/<pid>/cmdline on
+# multi-user hosts — with it, a local user could forge MAC'd frames to the
+# pickle RPC plane).
+_CONTAINER_SECRET_KEYS = frozenset({"RAYTPU_AUTH_TOKEN"})
+
 
 def container_spawn_command(container: dict, engine: str, env: dict,
                             session_dir: str, repo_root: str,
@@ -422,7 +445,10 @@ def container_spawn_command(container: dict, engine: str, env: dict,
         args += ["-w", cwd]
     for k in sorted(env):
         if k.startswith(_CONTAINER_ENV_PREFIXES):
-            args += ["--env", f"{k}={env[k]}"]
+            if k in _CONTAINER_SECRET_KEYS:
+                args += ["--env", k]  # value-less: inherited from client env
+            else:
+                args += ["--env", f"{k}={env[k]}"]
     args += list(container.get("run_options", []))
     args += [container["image"], "python", "-m", "ray_tpu.core.worker_main"]
     return args
@@ -451,7 +477,7 @@ async def materialize(spec: dict, cache_root: str, kv_get) -> tuple[dict, list, 
     if spec.get("container") is not None:
         engine = _container_engine()
         if engine is None:
-            raise RuntimeError(
+            raise RuntimeEnvSetupError(
                 "runtime_env requests a container but neither podman nor docker "
                 "is available on this node (set RAYTPU_CONTAINER_ENGINE)"
             )

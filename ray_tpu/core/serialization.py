@@ -178,6 +178,11 @@ def serialize_parts(value: Any) -> tuple[list, list, int]:
     buffers (ndarray payloads etc.) stay as zero-copy views so callers can
     scatter-write them straight into shared memory without an intermediate
     join (one memcpy for a large array put instead of two)."""
+    if type(value) in _ATOMIC_TYPES:  # see serialize(): no refs possible.
+        # Two parts, preserving the zero-extra-copy contract: a large bytes
+        # payload must not pay a concat before the scatter-write.
+        body = pickle.dumps(value, protocol=_PROTOCOL)
+        return [b"P", body], [], 1 + len(body)
     buffers: list[pickle.PickleBuffer] = []
     f = io.BytesIO()
     p = _RefAwarePickler(f, buffer_callback=buffers.append)
@@ -195,10 +200,35 @@ def serialize_parts(value: Any) -> tuple[list, list, int]:
     return parts, p.contained_refs, sum(len(x) for x in parts)
 
 
+# Types that cannot contain ObjectRefs, device arrays, or anything else the
+# ref-aware pickler exists for: plain pickle.dumps (the C fast path, no
+# CloudPickler construction) produces a byte-compatible "P" body.
+_ATOMIC_TYPES = frozenset({bytes, str, int, float, bool, type(None)})
+
+
 def serialize(value: Any) -> tuple[bytes, list]:
     """Serialize ``value`` -> (payload bytes, contained ObjectRefs)."""
+    if type(value) in _ATOMIC_TYPES:
+        # Tiny-reply/put fast path: building a _RefAwarePickler costs more
+        # than pickling these values; ~every actor-call reply is one.
+        return b"P" + pickle.dumps(value, protocol=_PROTOCOL), []
     parts, refs, _total = serialize_parts(value)
     return b"".join(parts), refs
+
+
+_EMPTY_ARGS_BLOB: bytes | None = None
+
+
+def serialize_args(args: tuple, kwargs: dict) -> tuple[bytes, list]:
+    """``serialize((args, kwargs))`` with a constant-blob fast path for the
+    empty call — the hot case for no-arg actor pings, where building a
+    CloudPickler per call costs more than the rest of the submission."""
+    if not args and not kwargs:
+        global _EMPTY_ARGS_BLOB
+        if _EMPTY_ARGS_BLOB is None:
+            _EMPTY_ARGS_BLOB = serialize(((), {}))[0]
+        return _EMPTY_ARGS_BLOB, []
+    return serialize((args, kwargs))
 
 
 def deserialize(data: bytes | memoryview) -> Any:

@@ -182,13 +182,18 @@ class _KeySubmitter:
                 raise
             self.workers.append(w)
         except Exception as e:
-            # Runtime-env materialization failures are PERMANENT for this
-            # task key (the env spec is part of the key): a missing conda
-            # binary / container engine / failed env build will fail
-            # identically on every retry — surface it to the caller instead
-            # of retrying the lease forever (reference: runtime-env agent
-            # setup errors fail the lease with a creation error).
-            if "runtime_env" in str(e):
+            # DETERMINISTIC runtime-env materialization failures are
+            # PERMANENT for this task key (the env spec is part of the key):
+            # a missing conda binary / container engine / failed env build
+            # will fail identically on every retry — surface it to the
+            # caller instead of retrying the lease forever (reference:
+            # runtime-env agent setup errors fail the lease with a creation
+            # error). The daemon raises RuntimeEnvSetupError for exactly
+            # that class (the type survives the RPC hop); transient faults
+            # (kv_get hiccup mid-download) take the retry branch.
+            from ray_tpu.core.runtime_env import RuntimeEnvSetupError
+
+            if isinstance(e, RuntimeEnvSetupError):
                 for spec, fut in self.queue:
                     self.core._fail_task_returns(spec, RuntimeError(str(e)))
                     if not fut.done():
@@ -336,6 +341,16 @@ class CoreWorker:
         self._events_reported = 0  # high-water mark shipped to the controller
         self._events_flush_lock = asyncio.Lock()
         self._current_task: Optional[TaskSpec] = None
+        # Buffered cross-thread submission lane: sync callers append
+        # closures; the IO loop is woken ONCE per burst instead of per call
+        # (call_soon_threadsafe writes the loop's self-pipe — a syscall per
+        # submission otherwise). FIFO safety: the drain callback is armed
+        # before any LATER call_soon_threadsafe / run_coroutine_threadsafe
+        # from the same caller thread, so everything posted before a sync
+        # get/free still lands first.
+        self._post_buf: collections.deque = collections.deque()
+        self._post_armed = False
+        self._post_lock = threading.Lock()
 
     # -- lifecycle ------------------------------------------------------
     def start_driver_sync(self):
@@ -522,6 +537,47 @@ class CoreWorker:
         self._executor.shutdown(wait=False)
 
     # -- helpers --------------------------------------------------------
+    def _post_to_loop(self, fn):
+        """Queue ``fn`` to run on the IO loop, coalescing wakeups: a burst
+        of submissions from a sync caller pays one self-pipe write, not one
+        per call. Posted order == execution order."""
+        with self._post_lock:
+            self._post_buf.append(fn)
+            if self._post_armed:
+                return
+            self._post_armed = True
+        try:
+            self.loop.call_soon_threadsafe(self._drain_posts)
+        except BaseException:
+            # ANY scheduling failure (closed loop RuntimeError, loop-not-
+            # started AttributeError) must disarm, or every later post
+            # no-ops silently and gets hang instead of this loud error.
+            with self._post_lock:
+                self._post_armed = False
+            raise
+
+    def _drain_posts(self):
+        # Loop until empty INSIDE one callback — never re-arm via call_soon.
+        # The FIFO contract with later cross-thread work depends on it: a fn
+        # posted while this drain runs must execute before a get/free the
+        # same caller thread schedules afterwards, and a deferred re-arm
+        # callback would land BEHIND that get in the ready queue. With the
+        # in-callback loop, either this drain's next round picks the fn up,
+        # or the post observed armed=False and scheduled a fresh drain
+        # before the caller could schedule the get.
+        while True:
+            with self._post_lock:
+                if not self._post_buf:
+                    self._post_armed = False
+                    return
+                fns = list(self._post_buf)
+                self._post_buf.clear()
+            for fn in fns:
+                try:
+                    fn()
+                except Exception:  # isolate: one bad post must not drop the rest
+                    logger.exception("posted submission callback failed")
+
     def _run(self, coro, timeout=None):
         """Run a coroutine on the IO loop from a sync context."""
         if self.loop is None:
@@ -702,7 +758,7 @@ class CoreWorker:
             if in_shm:
                 asyncio.ensure_future(self._report_shm_put(oid, total, evicted))
 
-        self.loop.call_soon_threadsafe(_commit)
+        self._post_to_loop(_commit)
         ref = ObjectRef(oid, self.address, total, _register=False)
         ref._registered = True
         return ref
@@ -787,8 +843,8 @@ class CoreWorker:
             if ref.owner_addr == self.address:
                 # Owner-local: the record is authoritative. PENDING, FAILED,
                 # or registration still queued on the IO loop (rec None —
-                # submit_actor_task_sync registers via call_soon_threadsafe,
-                # and the caller's get usually beats it) must NOT probe the
+                # submit_actor_task_sync registers via the posted-submission
+                # lane, and the caller's get usually beats it) must NOT probe the
                 # shm arena: a futile get_pinned + spill-restore stat per
                 # call was the sync-call hot path's biggest syscall cost.
                 rec = self.owned.get(oid)
@@ -1134,7 +1190,7 @@ class CoreWorker:
         return_refs = [] if streaming else [
             ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(n_returns)
         ]
-        args_blob, dep_refs = serialization.serialize((args, kwargs))
+        args_blob, dep_refs = serialization.serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1161,7 +1217,7 @@ class CoreWorker:
             else:
                 self._enqueue_submit(spec)
 
-        self.loop.call_soon_threadsafe(_go)
+        self._post_to_loop(_go)
         for r in return_refs:
             r._registered = True
         return gen if streaming else return_refs
@@ -1505,7 +1561,7 @@ class CoreWorker:
         task_id = TaskID.from_random()
         streaming = num_returns == "streaming"
         n_returns = -1 if streaming else num_returns
-        args_blob, dep_refs = serialization.serialize((args, kwargs))
+        args_blob, dep_refs = serialization.serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1531,7 +1587,7 @@ class CoreWorker:
             self._register_returns(refs)
             self._submit_actor_task(spec, dep_refs)
 
-        self.loop.call_soon_threadsafe(_go)
+        self._post_to_loop(_go)
         for r in refs:
             r._registered = True
         return gen if streaming else refs
@@ -1620,9 +1676,13 @@ class CoreWorker:
                 return
 
     async def _push_actor_batch_ordered(self, specs: list[TaskSpec], retried: bool = False):
-        """Issue one frame per task in pump order, then ONE transport flush
-        for the whole drain (each task keeps its own reply future, so a fast
-        call's result is never held behind a slow batchmate's).
+        """Issue one message per task in pump order, then ONE transport flush
+        for the whole drain. The messages are enqueued synchronously (no
+        await between call_starts), so the rpc layer coalesces the entire
+        drain into a single envelope: one pickle, one MAC, one write, one
+        executor wakeup per batch — while each task keeps its own reply
+        future, so a fast call's result is never held behind a slow
+        batchmate's (replies coalesce symmetrically on the way back).
 
         Failure ownership: every spec handed to this method gets an outcome
         here — a reply-awaiting task, a retry, or failed returns. Only

@@ -95,7 +95,8 @@ class ProxyActor:
     - otherwise pickled (app, deployment, method, args, kwargs) for
       trusted in-datacenter Python callers; reply = pickled
       ("ok", result) | ("err", message).
-    Both ride the same per-frame session-HMAC auth."""
+    Both ride the same per-frame keyed-BLAKE2b session tag (see
+    serve_rpc.proto — native keyed BLAKE2b, NOT HMAC)."""
 
     ROUTE_TTL_S = 1.0
 

@@ -283,6 +283,27 @@ def test_container_command_construction():
         "img:latest", "python", "-m", "ray_tpu.core.worker_main"]
 
 
+def test_auth_token_value_never_on_container_argv():
+    """The session MAC secret must not be readable via /proc/<pid>/cmdline:
+    RAYTPU_AUTH_TOKEN is forwarded as a VALUE-LESS `--env K` flag (engine
+    inherits the value from the client env Popen receives), never `K=V`
+    (ADVICE r05, medium)."""
+    from ray_tpu.core.runtime_env import container_spawn_command
+
+    secret = "deadbeefcafef00d" * 2
+    env = {"RAYTPU_AUTH_TOKEN": secret, "RAYTPU_WORKER_ID": "w1",
+           "RAYTPU_CONTROLLER_ADDR": "127.0.0.1:1"}
+    cmd = container_spawn_command(
+        {"image": "img:latest"}, "/usr/bin/podman", env, "/sess", "/repo",
+    )
+    assert not any(secret in c for c in cmd), f"token value leaked into argv: {cmd}"
+    # The variable is still forwarded — by name only.
+    i = cmd.index("RAYTPU_AUTH_TOKEN")
+    assert cmd[i - 1] == "--env"
+    # Non-secret control-plane vars keep the explicit K=V form.
+    assert "RAYTPU_WORKER_ID=w1" in cmd
+
+
 def test_container_fake_engine_end_to_end(shared_ray, tmp_path, monkeypatch):
     """Behind the seam: a fake engine script that applies the --env args and
     execs the command after the image name — the worker runs as a plain
@@ -298,7 +319,14 @@ i=0
 n=${#args[@]}
 while [ $i -lt $n ]; do
   a="${args[$i]}"
-  if [ "$a" == "--env" ]; then i=$((i+1)); envs+=("${args[$i]}");
+  if [ "$a" == "--env" ]; then
+    i=$((i+1)); e="${args[$i]}"
+    case "$e" in
+      *=*) envs+=("$e");;
+      # Value-less --env K: inherit from the engine client's own env —
+      # podman/docker semantics; how secrets (RAYTPU_AUTH_TOKEN) arrive.
+      *) envs+=("$e=${!e}");;
+    esac
   elif [ "$a" == "test-image:v1" ]; then i=$((i+1)); break; fi
   i=$((i+1))
 done

@@ -19,6 +19,40 @@ import pytest
 TOKEN = "start-cli-test-token"
 
 
+@pytest.fixture(autouse=True)
+def _fresh_auth_state():
+    """These tests assert properties of THIS test's session lifecycle
+    (mint -> scrub). Under a sequential full-suite run, an EARLIER module's
+    leaked state — an unscrubbed token in the process-global Config, a
+    Cluster record left in _LIVE_CLUSTERS by a crashed teardown, a stale
+    rpc frame key — made all three fail while each passes in isolation
+    (VERDICT r05 Weak #1). Force a clean slate on entry and exit instead of
+    asserting the previous module behaved: prior-test hygiene is not what
+    these tests are for, and a stale key would also make this module's
+    driver MAC-fail every frame against its own freshly-tokened cluster
+    (the observed connect timeout)."""
+    from ray_tpu.core import api, rpc
+    from ray_tpu.core.config import get_config
+
+    def scrub():
+        cfg = get_config()
+        cfg.auth_token = type(cfg)().apply_env().auth_token
+        rpc.set_auth_token(cfg.auth_token or None)
+        # Drop dead Cluster records: a live cluster's service thread is
+        # running; anything else only serves to make
+        # _token_owned_by_live_cluster veto the stale-mint drop for a
+        # session that no longer exists.
+        api._LIVE_CLUSTERS[:] = [
+            c for c in api._LIVE_CLUSTERS
+            if getattr(getattr(c, "host", None), "thread", None) is not None
+            and c.host.thread.is_alive()
+        ]
+
+    scrub()
+    yield
+    scrub()
+
+
 def _cli(env, *args, timeout=300):
     return subprocess.run(
         [sys.executable, "-m", "ray_tpu", *args],
