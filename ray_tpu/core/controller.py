@@ -159,6 +159,18 @@ class Controller:
         self._rr_counter = 0
         self._bg: list[asyncio.Task] = []
         self.events: list[dict] = []  # structured event log (ray_event_recorder equiv)
+        self.events_dropped = 0  # control events lost to log trims
+        self.task_events: list[dict] = []  # aggregated per-worker task events
+        self.task_events_dropped = 0  # task events lost to buffer trims
+        self.metrics_by_reporter: dict[str, tuple] = {}
+        # Trace index: trace_id -> {name, start, end, spans, workers, events}.
+        # Bounded both ways (traces and events-per-trace) so a chatty trace
+        # cannot grow controller memory; events stored here survive
+        # task_events trims, which is what makes /api/traces useful.
+        self.traces: dict[str, dict] = {}
+        self.traces_evicted = 0  # whole traces dropped by the index bound
+        self.MAX_TRACES = 256
+        self.MAX_TRACE_EVENTS = 512
         self._dirty = False
         # Actors restored from a snapshot as ALIVE/RESTARTING must be
         # re-confirmed by their daemon's re-registration within the grace
@@ -196,7 +208,9 @@ class Controller:
         self.events.append({"ts": time.time(), "kind": kind, **kw})
         self._dirty = True
         if len(self.events) > self.config.event_buffer_size:
-            del self.events[: len(self.events) // 2]
+            trimmed = len(self.events) // 2
+            self.events_dropped += trimmed
+            del self.events[:trimmed]
 
     # -- persistence (control-plane fault tolerance) --------------------
     async def _snapshot_loop(self):
@@ -488,7 +502,25 @@ class Controller:
         }
 
     def handle_get_events(self, conn, p):
-        return self.events[-int(p.get("limit", 1000)):]
+        events = self.events[-int(p.get("limit", 1000)):]
+        if not p.get("with_stats"):
+            return events
+        # Observable loss: silently-trimmed buffers are themselves a signal
+        # (satellite of the tracing work — nothing should vanish untallied).
+        worker_dropped = 0.0
+        for _ts, series in self.metrics_by_reporter.values():
+            for rec in series:
+                if rec["name"] == "events_dropped_total":
+                    worker_dropped += rec["value"]
+        return {
+            "events": events,
+            "dropped": {
+                "controller_events": self.events_dropped,
+                "task_events": self.task_events_dropped,
+                "worker_events": worker_dropped,
+                "traces_evicted": self.traces_evicted,
+            },
+        }
 
     def handle_get_autoscaler_state(self, conn, p):
         """Pending demand + per-node load for the autoscaler (reference:
@@ -525,39 +557,112 @@ class Controller:
 
     # -- task-event aggregation (TaskEventBuffer -> GcsTaskManager equiv) -
     def handle_report_task_events(self, conn, p):
-        if not hasattr(self, "task_events"):
-            self.task_events = []
         self.task_events.extend(p["events"])
+        for ev in p["events"]:
+            tid = ev.get("trace_id")
+            if tid:
+                self._index_trace_event(tid, ev)
         if len(self.task_events) > 4 * self.config.event_buffer_size:
-            del self.task_events[: len(self.task_events) // 2]
+            trimmed = len(self.task_events) // 2
+            self.task_events_dropped += trimmed
+            del self.task_events[:trimmed]
         return True
+
+    def _index_trace_event(self, trace_id: str, ev: dict):
+        t = self.traces.get(trace_id)
+        if t is None:
+            while len(self.traces) >= self.MAX_TRACES:
+                self.traces.pop(next(iter(self.traces)))  # evict oldest trace
+                self.traces_evicted += 1
+            t = self.traces[trace_id] = {
+                "name": "", "start": ev["ts"], "end": ev["ts"],
+                "spans": 0, "workers": set(), "events": [], "dropped": 0,
+            }
+        end = ev["ts"] + ev.get("dur", 0.0)
+        t["start"] = min(t["start"], ev["ts"])
+        t["end"] = max(t["end"], end)
+        kind = ev.get("kind", "")
+        if kind == "span":
+            t["spans"] += 1
+            if not ev.get("parent_id"):
+                t["name"] = ev.get("name", "")  # root span names the trace
+        elif kind == "task_exec_start":
+            t["spans"] += 1
+            if not t["name"]:
+                t["name"] = ev.get("fn", "")
+        t["workers"].add(ev.get("worker", "?"))
+        if len(t["events"]) < self.MAX_TRACE_EVENTS:
+            t["events"].append(ev)
+        else:
+            t["dropped"] += 1
 
     def handle_get_task_events(self, conn, p):
         limit = int(p.get("limit", 20000))
-        events = getattr(self, "task_events", [])
-        return events[-limit:] if limit > 0 else []
+        return self.task_events[-limit:] if limit > 0 else []
+
+    def handle_get_trace(self, conn, p):
+        """Every indexed event of one trace, time-ordered."""
+        t = self.traces.get(p["trace_id"])
+        if t is None:
+            return []
+        return sorted(t["events"], key=lambda e: e["ts"])
+
+    def handle_list_traces(self, conn, p):
+        """Recent traces, newest first; q filters by id prefix or root-span
+        name substring (the dashboard's /api/traces)."""
+        limit = int(p.get("limit", 100))
+        q = p.get("q") or ""
+        out = []
+        for trace_id in reversed(list(self.traces)):
+            t = self.traces[trace_id]
+            if q and not (trace_id.startswith(q) or q in t["name"]):
+                continue
+            out.append({
+                "trace_id": trace_id,
+                "name": t["name"],
+                "start": t["start"],
+                "dur": t["end"] - t["start"],
+                "spans": t["spans"],
+                "workers": len(t["workers"]),
+                "events": len(t["events"]),
+                "events_dropped": t["dropped"],
+            })
+            if len(out) >= limit:
+                break
+        return out
 
     # -- metrics aggregation (ray.util.metrics equivalent pipeline) ------
     def handle_report_metrics(self, conn, p):
-        if not hasattr(self, "metrics_by_reporter"):
-            self.metrics_by_reporter = {}
         self.metrics_by_reporter[p["reporter"]] = (time.monotonic(), p["series"])
         return True
 
     def handle_get_metrics(self, conn, p):
         """Merged view across LIVE reporters (entries older than 3 report
         intervals are dropped — dead workers must not contribute stale gauges
-        or leak controller memory). Counters/histograms sum; gauges sum;
-        histograms merge only when bucket boundaries match (mismatched
-        boundaries keep separate series instead of corrupting counts)."""
+        or leak controller memory). Counters/histograms sum; GAUGES stay one
+        series per reporter (a `reporter` tag is added) — summing a
+        point-in-time value like a memory fraction across processes reports
+        cluster-wide nonsense; per-reporter series let the scraper choose
+        max/avg. Histograms merge only when bucket boundaries match
+        (mismatched boundaries keep separate series instead of corrupting
+        counts)."""
         now = time.monotonic()
         horizon = 3 * self.config.metrics_report_interval_s + 5.0
-        reporters = getattr(self, "metrics_by_reporter", {})
+        reporters = self.metrics_by_reporter
         for rid in [r for r, (ts, _) in reporters.items() if now - ts > horizon]:
             del reporters[rid]
         merged: dict[tuple, dict] = {}
-        for _ts, series in reporters.values():
+        for rid, (_ts, series) in reporters.items():
             for rec in series:
+                if rec["kind"] == "gauge":
+                    tags = {**rec["tags"], "reporter": rid[:12]}
+                    key = (rec["name"], tuple(sorted(tags.items())), ())
+                    cur = merged.get(key)
+                    # Last write per reporter wins (reporters replace their
+                    # whole series each tick, so one entry per key anyway).
+                    if cur is None or rec.get("ts", 0) >= cur.get("ts", 0):
+                        merged[key] = {**rec, "tags": tags}
+                    continue
                 key = (rec["name"], tuple(sorted(rec["tags"].items())), tuple(rec.get("buckets") or ()))
                 cur = merged.get(key)
                 if cur is None:
@@ -568,7 +673,32 @@ class Controller:
                     cur["n"] += rec["n"]
                 else:
                     cur["value"] += rec["value"]
-        return list(merged.values())
+        return list(merged.values()) + self._controller_series()
+
+    def _controller_series(self) -> list[dict]:
+        """The controller's own runtime metrics, merged into every get_metrics
+        reply (the controller is not a reporter — it IS the aggregator)."""
+        ts = time.time()
+
+        def rec(name, kind, value, tags, desc=""):
+            return {"name": name, "kind": kind, "description": desc,
+                    "tags": {**tags, "reporter": "controller"},
+                    "value": float(value), "ts": ts}
+
+        out = [
+            rec("scheduler.pending", "gauge", len(self.pending_leases),
+                {"what": "leases"}, "lease requests waiting for capacity"),
+            rec("scheduler.pending", "gauge", len(self.pending_actors),
+                {"what": "actors"}, "actors parked until placeable"),
+        ]
+        if self.events_dropped:
+            out.append(rec("events_dropped_total", "counter", self.events_dropped,
+                           {"where": "controller"}, "control events lost to log trims"))
+        if self.task_events_dropped:
+            out.append(rec("events_dropped_total", "counter", self.task_events_dropped,
+                           {"where": "controller_task_buffer"},
+                           "aggregated task events lost to buffer trims"))
+        return out
 
     async def _health_check_loop(self):
         # Reference: GcsHealthCheckManager gRPC-probes raylets; here liveness

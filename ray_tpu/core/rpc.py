@@ -141,6 +141,10 @@ FRAME_TAG_LEN = _TAG_LEN
 # enough to keep always-on; bench_core.py reports them in row `detail`.
 _SEND_BATCH_HIST: collections.Counter = collections.Counter()
 _RECV_BATCH_HIST: collections.Counter = collections.Counter()
+# Bytes-on-wire (payload + header), both directions. Plain ints: one += per
+# frame on the hot path; promoted to first-class counters by metrics_series.
+_SEND_BYTES = 0
+_RECV_BYTES = 0
 
 
 def batch_stats(reset: bool = False) -> dict:
@@ -153,6 +157,56 @@ def batch_stats(reset: bool = False) -> dict:
     if reset:
         _SEND_BATCH_HIST.clear()
         _RECV_BATCH_HIST.clear()
+    return out
+
+
+# Envelope-size histogram bucket boundaries for the Prometheus view (the raw
+# per-size Counter stays available to bench via batch_stats).
+_ENVELOPE_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128]
+
+
+def metrics_series() -> list[dict]:
+    """This process's RPC transport counters as snapshot()-shaped metric
+    records (see ray_tpu.util.metrics): envelope batch-size histograms per
+    side + bytes-on-wire counters. Shipped by the CoreWorker reporter so the
+    coalescing behavior of the live cluster is visible on /metrics, not just
+    in bench_core histograms."""
+    import time as _time
+
+    now = _time.time()
+    out: list[dict] = []
+    for side, hist in (("send", _SEND_BATCH_HIST), ("recv", _RECV_BATCH_HIST)):
+        counts = [0] * (len(_ENVELOPE_BUCKETS) + 1)
+        total = 0.0
+        n = 0
+        for size, cnt in hist.items():
+            i = 0
+            while i < len(_ENVELOPE_BUCKETS) and size > _ENVELOPE_BUCKETS[i]:
+                i += 1
+            counts[i] += cnt
+            total += size * cnt
+            n += cnt
+        out.append({
+            "name": "rpc.envelope.messages",
+            "kind": "histogram",
+            "description": "messages coalesced per rpc envelope",
+            "tags": {"side": side},
+            "value": 0.0,
+            "ts": now,
+            "buckets": list(_ENVELOPE_BUCKETS),
+            "counts": counts,
+            "sum": total,
+            "n": n,
+        })
+    for side, nbytes in (("send", _SEND_BYTES), ("recv", _RECV_BYTES)):
+        out.append({
+            "name": "rpc.bytes",
+            "kind": "counter",
+            "description": "rpc bytes on the wire (frames incl. headers)",
+            "tags": {"side": side},
+            "value": float(nbytes),
+            "ts": now,
+        })
     return out
 
 
@@ -236,7 +290,9 @@ class Connection:
         _SEND_BATCH_HIST[len(msgs)] += 1
 
     def _write_frame(self, data: bytes):
+        global _SEND_BYTES
         data = _VER + _tag(data) + data if _frame_key else _VER + data
+        _SEND_BYTES += len(data) + _HDR
         try:
             self.writer.write(len(data).to_bytes(_HDR, "little") + data)
         except Exception:
@@ -331,6 +387,7 @@ class Connection:
         await self._send((_NOTIFY, 0, method, payload))
 
     async def _read_loop(self):
+        global _RECV_BYTES
         try:
             while True:
                 hdr = await self.reader.readexactly(_HDR)
@@ -339,6 +396,7 @@ class Connection:
                     logger.warning("dropping peer %s: absurd frame length %d", self.peer_name, ln)
                     return
                 data = await self.reader.readexactly(ln)
+                _RECV_BYTES += ln + _HDR
                 # Version check BEFORE auth/unpickle: a frame from a build
                 # with a different wire generation must never reach pickle.
                 if ln < 1 or data[0] != WIRE_VERSION:

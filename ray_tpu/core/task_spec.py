@@ -81,6 +81,10 @@ class TaskSpec:
     actor_id: Optional[ActorID] = None
     method_name: str = ""
     concurrency_group: str = ""  # "" = method default, then the default lane
+    # Distributed tracing: the caller's active (trace_id, span_id) at
+    # submission, or None (the overwhelmingly common case). Rides the pickled
+    # spec / lean-frame payload — no wire-version bump (util/tracing.py).
+    trace_ctx: Optional[tuple] = None
 
     @property
     def is_actor_task(self) -> bool:

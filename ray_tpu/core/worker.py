@@ -51,8 +51,22 @@ from ray_tpu.core.object_ref import (
 from ray_tpu.core.object_store import MemoryStore, ObjectStoreFullError, SharedMemoryClient
 from ray_tpu.core.serialization import RemoteError
 from ray_tpu.core.task_spec import ActorSpec, TaskOptions, TaskSpec, scheduling_key
+from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
+
+# Task execution latency (first-class runtime metric; ships via the
+# reporter -> controller -> /metrics pipeline). Bound series: the observe
+# hot path skips per-call tag-dict building.
+_task_latency = _metrics.Histogram(
+    "task.exec.latency_s",
+    "wall-clock task execution latency (seconds)",
+    boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30],
+    tag_keys=("kind",),
+)
+_task_latency_task = _task_latency.bind({"kind": "task"})
+_task_latency_actor = _task_latency.bind({"kind": "actor"})
 
 
 _MISS = object()  # sentinel: value not locally resident
@@ -226,9 +240,12 @@ class _KeySubmitter:
                     interned[key] = (spec.options, oid_small)  # pin: id() stays valid
                     wire.append({"spec": spec, "oid": oid_small})
                 else:
-                    wire.append({"lean": (
+                    msg = {"lean": (
                         spec.task_id.binary(), spec.args_blob, spec.num_returns, ent[1],
-                    )})
+                    )}
+                    if spec.trace_ctx is not None:
+                        msg["tc"] = spec.trace_ctx
+                    wire.append(msg)
             reply = await w.conn.call("push_tasks", {"specs": wire})
             for (spec, fut), r in zip(items, reply["results"]):
                 self.core._absorb_task_reply(spec, r, fut)
@@ -339,7 +356,14 @@ class CoreWorker:
         self._shm_garbage: list[ObjectID] = []
         self.task_events: list[dict] = []  # per-task event buffer (task_event_buffer.h equiv)
         self._events_reported = 0  # high-water mark shipped to the controller
+        self._events_dropped = 0  # events discarded by buffer trims (observable loss)
         self._events_flush_lock = asyncio.Lock()
+        # Object-store access counters (plain ints: no lock on the get/put
+        # hot paths; shipped as counter series by the metrics reporter).
+        self._obj_hits = 0
+        self._obj_misses = 0
+        self._obj_bytes_read = 0
+        self._obj_bytes_written = 0
         self._current_task: Optional[TaskSpec] = None
         # Buffered cross-thread submission lane: sync callers append
         # closures; the IO loop is woken ONCE per burst instead of per call
@@ -360,7 +384,11 @@ class CoreWorker:
         def run():
             self.loop = asyncio.new_event_loop()
             asyncio.set_event_loop(self.loop)
-            self.loop.create_task(self._async_init(ready))
+            # Strong reference: asyncio only weakly tracks tasks, and an
+            # unreferenced init task can be GC'd mid-await (GeneratorExit) —
+            # observed as a flaky "driver failed to connect" when import
+            # pressure shifted a gc cycle into the dial window.
+            self._init_task = self.loop.create_task(self._async_init(ready))
             self.loop.run_forever()
 
         self._loop_thread = threading.Thread(target=run, name="raytpu-io", daemon=True)
@@ -478,14 +506,44 @@ class CoreWorker:
         controller (reference: per-node agent scrape -> dashboard, and the
         TaskEventBuffer -> GcsTaskManager pipeline, task_event_buffer.h)."""
         try:
-            from ray_tpu.util import metrics as _m
-
-            series = _m.snapshot()
+            series = _metrics.snapshot() + self._runtime_series()
             if series:
                 await self.controller.notify("report_metrics", {"reporter": self.worker_id, "series": series})
         except Exception:
             pass
         await self._flush_task_events()
+
+    def _runtime_series(self) -> list[dict]:
+        """First-class runtime metrics that live outside the user registry:
+        RPC envelope/byte counters (rpc.metrics_series), queue-depth gauges,
+        object-store access counters, dropped-event counters. Records are
+        snapshot()-shaped so they merge through the same controller
+        pipeline."""
+        now = time.time()
+        out = rpc.metrics_series()
+
+        def rec(name, kind, value, tags, desc=""):
+            out.append({"name": name, "kind": kind, "description": desc,
+                        "tags": tags, "value": float(value), "ts": now})
+
+        rec("scheduler.queue.depth", "gauge",
+            sum(len(s.queue) for s in self._submitters.values()),
+            {"queue": "submitter"}, "task specs queued awaiting worker leases")
+        rec("scheduler.queue.depth", "gauge",
+            sum(q.qsize() for q in self._actor_send_queues.values()),
+            {"queue": "actor_pump"}, "actor tasks buffered in send pumps")
+        rec("object.store.ops", "counter", self._obj_hits,
+            {"result": "hit"}, "object reads resolved from local memory/shm")
+        rec("object.store.ops", "counter", self._obj_misses,
+            {"result": "miss"}, "object reads that needed a remote fetch/recovery")
+        rec("object.store.bytes", "counter", self._obj_bytes_read,
+            {"op": "read"}, "object bytes read locally")
+        rec("object.store.bytes", "counter", self._obj_bytes_written,
+            {"op": "write"}, "object bytes written by put/task returns")
+        if self._events_dropped:
+            rec("events_dropped_total", "counter", self._events_dropped,
+                {"where": "worker"}, "task events lost to buffer trims before reporting")
+        return out
 
     async def _flush_task_events(self):
         # Serialize flushes: the periodic reporter and on-demand
@@ -613,6 +671,9 @@ class CoreWorker:
         self.task_events.append({"ts": time.time(), "kind": kind, "worker": self.worker_id[:12], **kw})
         if len(self.task_events) > self.config.event_buffer_size:
             trimmed = len(self.task_events) // 2
+            # Only events the controller never saw are LOST; already-reported
+            # ones were shipped before the trim.
+            self._events_dropped += max(0, trimmed - self._events_reported)
             del self.task_events[:trimmed]
             self._events_reported = max(0, self._events_reported - trimmed)
 
@@ -750,6 +811,7 @@ class CoreWorker:
             self.store.seal(oid)
         else:
             self.memory_store.put(oid, b"".join(parts))
+        self._obj_bytes_written += total
 
         def _commit():
             rec = self._register_owned(oid)
@@ -786,6 +848,7 @@ class CoreWorker:
             self._mark_ready(oid, size=len(data), in_memory=False, in_shm=True)
         else:
             self.memory_store.put(oid, data)
+            self._obj_bytes_written += len(data)
             self._mark_ready(oid, size=len(data), in_memory=True, in_shm=False)
         ref = ObjectRef(oid, self.address, len(data), _register=False)
         ref._registered = True
@@ -796,6 +859,7 @@ class CoreWorker:
         buf[:] = data
         del buf
         self.store.seal(oid)
+        self._obj_bytes_written += len(data)
         if evicted:
             await self._report_evicted(evicted)
         if self.daemon is not None:
@@ -855,6 +919,8 @@ class CoreWorker:
             data = self._read_shm(oid)
             if data is None:
                 return _MISS
+        self._obj_hits += 1
+        self._obj_bytes_read += len(data)
         return self._deserialize_value(data)
 
     async def get_async(self, ref: ObjectRef):
@@ -868,6 +934,8 @@ class CoreWorker:
         # 1. in-process memory store
         data = self.memory_store.get(oid)
         if data is not None:
+            self._obj_hits += 1
+            self._obj_bytes_read += len(data)
             return self._deserialize_value(data)
         # 2. owned & pending -> wait for completion
         rec = self.owned.get(oid)
@@ -882,12 +950,17 @@ class CoreWorker:
                 raise err
             data = self.memory_store.get(oid)
             if data is not None:
+                self._obj_hits += 1
+                self._obj_bytes_read += len(data)
                 return self._deserialize_value(data)
         # 3. local shared memory
         data = self._read_shm(oid)
         if data is not None:
+            self._obj_hits += 1
+            self._obj_bytes_read += len(data)
             return self._deserialize_value(data)
-        # 4. borrowed -> ask the owner
+        # 4. borrowed -> ask the owner (a local miss from here on)
+        self._obj_misses += 1
         if ref.owner_addr and ref.owner_addr != self.address:
             try:
                 conn = await self._peer_conn(ref.owner_addr)
@@ -1199,6 +1272,7 @@ class CoreWorker:
             num_returns=n_returns,
             options=opts,
             caller_addr=self.address,
+            trace_ctx=_tracing.current_trace(),  # None unless a span is active
         )
         gen = ObjectRefGenerator(task_id, self.address) if streaming else None
         if gen is not None:
@@ -1244,7 +1318,12 @@ class CoreWorker:
         fut = self.loop.create_future()
         fut.add_done_callback(lambda f: f.exception())  # results absorbed via _absorb_task_reply
         sub.queue.append((spec, fut))
-        self._event("task_submitted", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
+        tc = spec.trace_ctx
+        if tc is None:
+            self._event("task_submitted", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
+        else:
+            self._event("task_submitted", task_id=spec.task_id.hex(), fn=spec.fn_id[:24],
+                        trace_id=tc[0], span_id=tc[1])
         sub.pump()
 
     async def _wait_deps(self, dep_refs: list[ObjectRef]):
@@ -1362,6 +1441,7 @@ class CoreWorker:
         return TaskSpec(
             task_id=TaskID(tid), job_id=job_id, fn_id=fn_id, args_blob=args_blob,
             num_returns=num_returns, options=options, caller_addr=caller_addr,
+            trace_ctx=p.get("tc"),
         )
 
     async def handle_push_task(self, conn, p):
@@ -1374,7 +1454,16 @@ class CoreWorker:
         try:
             fn = await self._load_callable(spec.fn_id)
             loop = asyncio.get_running_loop()
-            self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
+            tc = spec.trace_ctx
+            if tc is None:
+                self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
+            else:
+                # The execution span: child of the submitter's span; user code
+                # inside the task sees (trace_id, exec_span) as its context.
+                spec._exec_ctx = (tc[0], _tracing.new_span_id())  # type: ignore[attr-defined]
+                self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24],
+                            trace_id=tc[0], span_id=spec._exec_ctx[1], parent_id=tc[1])
+            t0 = time.monotonic()
             try:
                 if streaming:
                     n = await self._execute_streaming_task(conn, fn, spec, loop)
@@ -1385,7 +1474,14 @@ class CoreWorker:
             except BaseException as e:  # noqa: BLE001 - errors propagate to caller
                 return {"status": "error", "error": serialization.RemoteError.from_exception(e, where=f"task {spec.fn_id[:24]}")}
             finally:
-                self._event("task_exec_end", task_id=spec.task_id.hex())
+                _task_latency_task.observe(time.monotonic() - t0)
+                if tc is None:
+                    self._event("task_exec_end", task_id=spec.task_id.hex())
+                else:
+                    # Carry the trace id so the controller's trace index sees
+                    # the execution END too (duration, not just the start).
+                    self._event("task_exec_end", task_id=spec.task_id.hex(),
+                                trace_id=tc[0], span_id=spec._exec_ctx[1])
         finally:
             if streaming:
                 self._stream_cleanup(spec.task_id.binary())
@@ -1401,23 +1497,29 @@ class CoreWorker:
         _generator_backpressure_num_objects, default unbounded)."""
 
         def run():
-            out = self._execute_task(fn, spec)
-            if not inspect.isgenerator(out):
-                raise TypeError(
-                    f"task {spec.fn_id[:24]} declared num_returns='streaming' "
-                    f"but returned {type(out).__name__}, not a generator"
-                )
-            count = 0
-            for value in out:
-                try:
-                    asyncio.run_coroutine_threadsafe(
-                        self._ship_generator_item(conn, spec, count, value), loop
-                    ).result()
-                except _StreamClosed:
-                    out.close()
-                    break
-                count += 1
-            return count
+            # Context active for the generator BODY too (it runs during the
+            # next() calls below, not inside _execute_task's window).
+            token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+            try:
+                out = self._execute_task(fn, spec)
+                if not inspect.isgenerator(out):
+                    raise TypeError(
+                        f"task {spec.fn_id[:24]} declared num_returns='streaming' "
+                        f"but returned {type(out).__name__}, not a generator"
+                    )
+                count = 0
+                for value in out:
+                    try:
+                        asyncio.run_coroutine_threadsafe(
+                            self._ship_generator_item(conn, spec, count, value), loop
+                        ).result()
+                    except _StreamClosed:
+                        out.close()
+                        break
+                    count += 1
+                return count
+            finally:
+                _tracing.deactivate(token)
 
         # Stream state registered/cleaned by handle_push_task's try/finally.
         return await loop.run_in_executor(self._executor, run)
@@ -1500,9 +1602,14 @@ class CoreWorker:
         args = [self.get_sync(a) if isinstance(a, ObjectRef) else a for a in args]
         kwargs = {k: (self.get_sync(v) if isinstance(v, ObjectRef) else v) for k, v in kwargs.items()}
         self._current_task = spec
+        # Executor threads don't inherit the IO loop's contextvars: install
+        # the task's execution span (if traced) so user-code spans and nested
+        # submissions chain onto it.
+        token = _tracing.activate(getattr(spec, "_exec_ctx", None))
         try:
             return fn(*args, **kwargs)
         finally:
+            _tracing.deactivate(token)
             self._current_task = None
 
     async def _package_value(self, oid: ObjectID, value) -> dict:
@@ -1562,6 +1669,7 @@ class CoreWorker:
         streaming = num_returns == "streaming"
         n_returns = -1 if streaming else num_returns
         args_blob, dep_refs = serialization.serialize_args(args, kwargs)
+        tc = _tracing.current_trace()
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1573,6 +1681,7 @@ class CoreWorker:
             actor_id=actor_id,
             method_name=method,
             concurrency_group=concurrency_group,
+            trace_ctx=tc,
         )
         refs = [] if streaming else [
             ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(n_returns)
@@ -1585,6 +1694,12 @@ class CoreWorker:
             if gen is not None:
                 self._streaming[task_id.binary()] = gen
             self._register_returns(refs)
+            if tc is not None:
+                # Submission event ONLY when traced: actor calls are the hot
+                # path and normally emit no events at all (export_timeline's
+                # flow arrows need the submit side of the hop).
+                self._event("task_submitted", task_id=spec.task_id.hex(),
+                            fn=method[:24], trace_id=tc[0], span_id=tc[1])
             self._submit_actor_task(spec, dep_refs)
 
         self._post_to_loop(_go)
@@ -1725,6 +1840,8 @@ class CoreWorker:
                         spec.task_id.binary(), spec.method_name, spec.args_blob,
                         spec.num_returns, spec.concurrency_group, ent[1],
                     )}
+                    if spec.trace_ctx is not None:
+                        payload["tc"] = spec.trace_ctx
                 sent.append((spec, entry["conn"].call_start("push_actor_task", payload)))
             # Backpressure: bound the transport buffer before the next drain.
             await entry["conn"].flush()
@@ -1921,15 +2038,31 @@ class CoreWorker:
                 task_id=TaskID(tid), job_id=job_id, fn_id="", args_blob=args_blob,
                 num_returns=num_returns, options=options, caller_addr=caller_addr,
                 actor_id=actor_id, method_name=method, concurrency_group=cg,
+                trace_ctx=p.get("tc"),
             )
         streaming = spec.num_returns == -1
         if streaming:
             # Synchronous registration before the first await — see
             # _stream_register for the ordering contract with generator_close.
             self._stream_register(spec.task_id.binary())
+        tc = spec.trace_ctx
+        if tc is not None:
+            # Exec-span events ONLY when traced: untraced actor calls keep
+            # their zero-event hot path (the latency histogram below is the
+            # always-on signal).
+            spec._exec_ctx = (tc[0], _tracing.new_span_id())  # type: ignore[attr-defined]
+            self._event("task_exec_start", task_id=spec.task_id.hex(),
+                        fn=spec.method_name[:24], trace_id=tc[0],
+                        span_id=spec._exec_ctx[1], parent_id=tc[1])
+        t0 = time.monotonic()
         try:
             return await self._actor_runtime.execute(spec, conn)
         finally:
+            _task_latency_actor.observe(time.monotonic() - t0)
+            if tc is not None:
+                # trace id rides along so the index records the end (duration).
+                self._event("task_exec_end", task_id=spec.task_id.hex(),
+                            trace_id=tc[0], span_id=spec._exec_ctx[1])
             if streaming:
                 self._stream_cleanup(spec.task_id.binary())
 
@@ -2012,6 +2145,19 @@ class CoreWorker:
 
     def handle_health_check(self, conn, p):
         return {"ok": True, "worker_id": self.worker_id}
+
+    def handle_debug_observability(self, conn, p):
+        """Ground-truth snapshot of this worker's observability state (used
+        by dashboards/tests to distinguish 'never recorded' from 'never
+        flushed' without waiting on reporter ticks)."""
+        tail = int(p.get("tail", 5))
+        return {
+            "worker_id": self.worker_id,
+            "task_events_len": len(self.task_events),
+            "events_reported": self._events_reported,
+            "events_dropped": self._events_dropped,
+            "tail": self.task_events[-tail:] if tail > 0 else [],
+        }
 
 
 class ActorRuntime:
@@ -2099,37 +2245,46 @@ class ActorRuntime:
         if inspect.isasyncgenfunction(method):
             args, kwargs = await loop.run_in_executor(None, self._resolve, spec.args_blob)
             count = 0
-            async with sem:
-                agen = method(*args, **kwargs)
-                try:
-                    async for value in agen:
-                        try:
-                            await self.core._ship_generator_item(conn, spec, count, value)
-                        except _StreamClosed:
-                            break
-                        count += 1
-                finally:
-                    await agen.aclose()
-            return count
+            token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+            try:
+                async with sem:
+                    agen = method(*args, **kwargs)
+                    try:
+                        async for value in agen:
+                            try:
+                                await self.core._ship_generator_item(conn, spec, count, value)
+                            except _StreamClosed:
+                                break
+                            count += 1
+                    finally:
+                        await agen.aclose()
+                return count
+            finally:
+                _tracing.deactivate(token)
 
         def run():
-            out = self._call_sync(method, spec)
-            if not inspect.isgenerator(out):
-                raise TypeError(
-                    f"actor method {spec.method_name} declared "
-                    f"num_returns='streaming' but returned {type(out).__name__}"
-                )
-            n = 0
-            for value in out:
-                try:
-                    asyncio.run_coroutine_threadsafe(
-                        self.core._ship_generator_item(conn, spec, n, value), loop
-                    ).result()
-                except _StreamClosed:
-                    out.close()
-                    break
-                n += 1
-            return n
+            # Context active for the generator BODY (runs during next()).
+            token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+            try:
+                out = self._call_sync(method, spec)
+                if not inspect.isgenerator(out):
+                    raise TypeError(
+                        f"actor method {spec.method_name} declared "
+                        f"num_returns='streaming' but returned {type(out).__name__}"
+                    )
+                n = 0
+                for value in out:
+                    try:
+                        asyncio.run_coroutine_threadsafe(
+                            self.core._ship_generator_item(conn, spec, n, value), loop
+                        ).result()
+                    except _StreamClosed:
+                        out.close()
+                        break
+                    n += 1
+                return n
+            finally:
+                _tracing.deactivate(token)
 
         # Stream state registered/cleaned by handle_push_actor_task's
         # try/finally around execute().
@@ -2143,11 +2298,21 @@ class ActorRuntime:
 
     def _call_sync(self, method, spec: TaskSpec):
         args, kwargs = self._resolve(spec.args_blob)
-        return method(*args, **kwargs)
+        # Pool threads don't inherit the IO loop's contextvars: install the
+        # call's execution span (if traced) so user code chains onto it.
+        token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+        try:
+            return method(*args, **kwargs)
+        finally:
+            _tracing.deactivate(token)
 
     async def _call_async(self, method, spec: TaskSpec):
         args, kwargs = await asyncio.get_running_loop().run_in_executor(None, self._resolve, spec.args_blob)
-        return await method(*args, **kwargs)
+        token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+        try:
+            return await method(*args, **kwargs)
+        finally:
+            _tracing.deactivate(token)
 
     def on_exit(self):
         inst = self.instance

@@ -44,7 +44,9 @@ def main():
 
     api._set_global_worker(core)
 
-    loop.create_task(init())
+    # Strong reference: an unreferenced init task can be GC'd mid-await
+    # (same latent footgun as CoreWorker.start_driver_sync's init task).
+    init_task = loop.create_task(init())
     try:
         loop.run_forever()
     finally:

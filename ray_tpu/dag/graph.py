@@ -141,19 +141,23 @@ class CompiledDAG:
             seq = self._seq
             self._seq += 1
             self._events[seq] = threading.Event()
-        self.core._run(self._feed(seq, value))
+        # Trace context captured on the CALLER's thread (the IO-loop coroutine
+        # below runs in the loop's context, not ours).
+        from ray_tpu.util import tracing as _tracing
+
+        self.core._run(self._feed(seq, value, _tracing.current_trace()))
         return _DagResult(self, seq)
 
-    async def _feed(self, seq: int, value: Any):
+    async def _feed(self, seq: int, value: Any, tc=None):
         from ray_tpu.core import serialization
 
         blob, _ = serialization.serialize(value)
         for addr, stage, slot in self.input_feeds:
             conn = await self.core._peer_conn(addr)
-            await conn.notify(
-                "dag_push",
-                {"dag_id": self.dag_id, "stage_id": stage, "seq": seq, "slot": slot, "blob": blob, "is_error": False},
-            )
+            msg = {"dag_id": self.dag_id, "stage_id": stage, "seq": seq, "slot": slot, "blob": blob, "is_error": False}
+            if tc is not None:
+                msg["tc"] = tc
+            await conn.notify("dag_push", msg)
 
     def _deliver(self, seq: int, value: Any):
         with self._lock:

@@ -11,10 +11,12 @@ connections — never through the object store or the driver.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any
 
 from ray_tpu.core import serialization
 from ray_tpu.core.ids import ActorID
+from ray_tpu.util import tracing as _tracing
 
 
 def resolve_actor_addr(core, actor_handle) -> str:
@@ -45,6 +47,7 @@ class _StageState:
     def __init__(self, spec: dict):
         self.spec = spec
         self.pending: dict[int, dict[int, Any]] = {}  # seq -> slot -> value/err
+        self.trace: dict[int, tuple] = {}  # seq -> propagated (trace_id, span_id)
 
 
 def _dag_tables(core):
@@ -83,6 +86,10 @@ async def dag_push(core, conn, p):
         return False  # torn down
     seq = p["seq"]
     slot_map = st.pending.setdefault(seq, {})
+    if "tc" in p:
+        # Fan-in stages may receive one context per input; keep the first
+        # (stable within a run) rather than last-writer-wins re-parenting.
+        st.trace.setdefault(seq, p["tc"])
     if "shm_oid" in p:
         slot_map[p["slot"]] = (_ShmValue(p["shm_oid"], conn), p["is_error"])
     else:
@@ -90,7 +97,7 @@ async def dag_push(core, conn, p):
     if len(slot_map) < st.spec["n_inputs"]:
         return True
     del st.pending[seq]
-    asyncio.create_task(_run_stage(core, st.spec, seq, slot_map))
+    asyncio.create_task(_run_stage(core, st.spec, seq, slot_map, st.trace.pop(seq, None)))
     return True
 
 
@@ -105,7 +112,7 @@ class _ShmValue:
         self.conn = conn
 
 
-async def _run_stage(core, spec: dict, seq: int, slot_map: dict):
+async def _run_stage(core, spec: dict, seq: int, slot_map: dict, tc=None):
     # Error propagation: any errored input short-circuits the stage — but
     # shm-riding inputs must still be acked or their producer pins leak.
     err_blob = next((blob for blob, is_err in slot_map.values() if is_err), None)
@@ -116,8 +123,17 @@ async def _run_stage(core, spec: dict, seq: int, slot_map: dict):
                     await blob.conn.notify("dag_shm_ack", {"oid": blob.oid})
                 except Exception:
                     pass
-        await _emit(core, spec, seq, err_blob, is_error=True)
+        await _emit(core, spec, seq, err_blob, is_error=True, tc=tc)
         return
+    exec_ctx = None
+    t_start = 0.0
+    if tc is not None:
+        # One span per stage execution, child of the upstream context; its
+        # id propagates downstream so the chain stays parent-linked. The
+        # span event is recorded in the finally below (the stage method runs
+        # on a pool thread; exec_ctx is activated inside that thread).
+        exec_ctx = (tc[0], _tracing.new_span_id())
+        t_start = time.time()
     runtime = core._actor_runtime
     acks: list[_ShmValue] = []
     try:
@@ -145,17 +161,32 @@ async def _run_stage(core, spec: dict, seq: int, slot_map: dict):
             # Same max_concurrency gate as ActorRuntime.execute — pipelined
             # seqs must not exceed the actor's declared concurrency.
             async with runtime.sem:
-                result = await method(*args)
+                token = _tracing.activate(exec_ctx)
+                try:
+                    result = await method(*args)
+                finally:
+                    _tracing.deactivate(token)
         else:
             # The actor's own pool: respects its max_concurrency semantics.
-            result = await loop.run_in_executor(runtime.pool, lambda: method(*args))
+            def _call():
+                token = _tracing.activate(exec_ctx)
+                try:
+                    return method(*args)
+                finally:
+                    _tracing.deactivate(token)
+
+            result = await loop.run_in_executor(runtime.pool, _call)
         blob, _ = serialization.serialize(result)
-        await _emit(core, spec, seq, blob, is_error=False)
+        await _emit(core, spec, seq, blob, is_error=False, tc=exec_ctx)
     except BaseException as e:  # noqa: BLE001 — ships to the driver
         err = serialization.RemoteError.from_exception(e, where=f"dag stage {spec['method']}")
         blob, _ = serialization.serialize(err.cause if err.cause is not None else err)
-        await _emit(core, spec, seq, blob, is_error=True)
+        await _emit(core, spec, seq, blob, is_error=True, tc=exec_ctx)
     finally:
+        if exec_ctx is not None:
+            core._event("span", name=f"dag.{spec['method']}", trace_id=exec_ctx[0],
+                        span_id=exec_ctx[1], parent_id=tc[1], ts=t_start,
+                        dur=time.time() - t_start)
         for sv in acks:
             try:
                 await sv.conn.notify("dag_shm_ack", {"oid": sv.oid})
@@ -193,7 +224,7 @@ async def _same_arena(core, addr: str) -> bool:
     return same
 
 
-async def _emit(core, spec: dict, seq: int, blob: bytes, is_error: bool):
+async def _emit(core, spec: dict, seq: int, blob: bytes, is_error: bool, tc=None):
     """Ship a stage output downstream. Same-node edges with large payloads
     ride the shared-memory arena zero-copy (the mutable-plasma channel
     equivalent — reference: experimental/channel/shared_memory_channel.py):
@@ -239,6 +270,8 @@ async def _emit(core, spec: dict, seq: int, blob: bytes, is_error: bool):
     for addr, stage, slot in spec["downstream"]:
         conn = await core._peer_conn(addr)
         msg = {"dag_id": spec["dag_id"], "stage_id": stage, "seq": seq, "slot": slot, "is_error": is_error}
+        if tc is not None:
+            msg["tc"] = tc
         if shm_oid is not None and (addr, stage, slot) in shm_targets:
             msg["shm_oid"] = shm_oid
         else:

@@ -23,9 +23,10 @@ h3{margin-bottom:0.1em}.muted{color:#777;font-size:0.85em}
 <h2>ray_tpu cluster</h2>
 <div class=muted>auto-refreshes every 3s —
 <a href=/api/cluster>cluster</a> · <a href=/api/events>events</a> ·
-<a href=/api/metrics>metrics</a> · <a href=/api/jobs>jobs</a> ·
-<a href=/metrics>prometheus</a> ·
-profile a worker: <code>/api/profile?addr=IP:PORT&duration=2</code></div>
+<a href=/api/metrics>metrics</a> · <a href=/api/traces>traces</a> ·
+<a href=/api/jobs>jobs</a> · <a href=/metrics>prometheus</a> ·
+profile a worker: <code>/api/profile?addr=IP:PORT&duration=2</code> ·
+trace search: <code>/api/traces?q=NAME</code>, one trace: <code>/api/traces?id=TRACE_ID</code></div>
 <h3>Nodes</h3><table id=nodes></table>
 <h3>Actors</h3><table id=actors></table>
 <h3>Placement groups</h3><table id=pgs></table>
@@ -74,8 +75,21 @@ def _payload(path: str):
         return api.profile_worker(addr, duration)
     if path == "/api/cluster":
         return core._run(core.controller.call("get_cluster_state", {}))
-    if path == "/api/events":
-        return core._run(core.controller.call("get_events", {"limit": 1000}))
+    if path.startswith("/api/events"):
+        return core._run(core.controller.call("get_events", {"limit": 1000, "with_stats": True}))
+    if path.startswith("/api/traces"):
+        # Recent traces; ?id=<trace_id> fetches one trace's events,
+        # ?q=<substr> filters by id prefix / root-span name.
+        from urllib.parse import parse_qs, urlsplit
+
+        q = parse_qs(urlsplit(path).query)
+        trace_id = (q.get("id") or [""])[0]
+        if trace_id:
+            return core._run(core.controller.call("get_trace", {"trace_id": trace_id}))
+        return core._run(core.controller.call(
+            "list_traces",
+            {"limit": int((q.get("limit") or ["100"])[0]), "q": (q.get("q") or [""])[0]},
+        ))
     if path == "/api/metrics":
         return core._run(core.controller.call("get_metrics", {}))
     if path == "/api/jobs":

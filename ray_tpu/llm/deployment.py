@@ -115,18 +115,23 @@ class LLMServer:
         """Blocking generate; safe to call from many router threads at once —
         the engine batches all in-flight requests per decode iteration.
         sampling: per-request SamplingParams (or kwargs dict for one)."""
+        from ray_tpu.util import tracing as _tracing
+
         sampling = _coerce_sampling(sampling)
-        with self._cond:
-            rid = self._new_rid()
-            self.engine.add_request(rid, tokens, max_tokens, sampling=sampling)
-            self._cond.notify_all()
-            deadline = time.time() + timeout_s
-            while rid not in self._done:
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    raise TimeoutError(f"generate timed out after {timeout_s}s")
-                self._cond.wait(timeout=min(remaining, 1.0))
-            return self._done.pop(rid)
+        # child_span: free no-op unless the request arrived with a trace
+        # (serve proxy/handle context rides the actor call into this thread).
+        with _tracing.child_span("llm.generate", max_tokens=max_tokens):
+            with self._cond:
+                rid = self._new_rid()
+                self.engine.add_request(rid, tokens, max_tokens, sampling=sampling)
+                self._cond.notify_all()
+                deadline = time.time() + timeout_s
+                while rid not in self._done:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        raise TimeoutError(f"generate timed out after {timeout_s}s")
+                    self._cond.wait(timeout=min(remaining, 1.0))
+                return self._done.pop(rid)
 
     def generate_stream(self, tokens, max_tokens: int = 64, timeout_s: float = 300.0,
                         sampling=None):

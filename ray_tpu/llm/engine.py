@@ -1205,13 +1205,17 @@ class LLMEngine:
     def generate(self, tokens, max_tokens: int = 64,
                  sampling: SamplingParams | None = None) -> dict:
         """Synchronous single-request convenience: returns {"tokens", "ttft_s"}."""
+        from ray_tpu.util.tracing import child_span
+
         req_id = f"g{time.monotonic_ns()}"
-        self.add_request(req_id, tokens, max_tokens, sampling=sampling)
-        ttft = None
-        while True:
-            events = self.step()
-            ev = events.get(req_id)
-            if ev and ev.get("ttft_s") is not None:
-                ttft = ev["ttft_s"]
-            if ev and ev.get("finished"):
-                return {"tokens": ev["tokens"], "ttft_s": ttft}
+        # No-op unless a distributed trace is active in this thread.
+        with child_span("llm.engine.generate", max_tokens=max_tokens):
+            self.add_request(req_id, tokens, max_tokens, sampling=sampling)
+            ttft = None
+            while True:
+                events = self.step()
+                ev = events.get(req_id)
+                if ev and ev.get("ttft_s") is not None:
+                    ttft = ev["ttft_s"]
+                if ev and ev.get("finished"):
+                    return {"tokens": ev["tokens"], "ttft_s": ttft}

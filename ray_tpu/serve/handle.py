@@ -80,6 +80,18 @@ class _ReplicaSet:
         self._outstanding: list[tuple[Any, str]] = []  # (ref, replica_name)
         self._drainer: Optional[threading.Thread] = None
         self._pusher: Optional[threading.Thread] = None
+        # First-class queue-depth gauges (per process per deployment; the
+        # controller keeps gauges as per-reporter series, so each handle
+        # process's router state stays separable on /metrics).
+        from ray_tpu.util import metrics as _metrics
+
+        tags = {"app": app_name, "deployment": deployment_name}
+        self._queue_gauge = _metrics.Gauge(
+            "serve.handle.queued", "requests waiting for replica capacity in this handle",
+            tag_keys=("app", "deployment")).set_default_tags(tags)
+        self._ongoing_gauge = _metrics.Gauge(
+            "serve.handle.ongoing", "requests in flight to replicas from this handle",
+            tag_keys=("app", "deployment")).set_default_tags(tags)
 
     # -- membership --------------------------------------------------------
     def _maybe_refresh(self):
@@ -321,7 +333,10 @@ class _ReplicaSet:
         while not self._closed:
             time.sleep(0.25)
             with self.cond:
-                demand = self.queued + sum(self.ongoing.values())
+                queued, ongoing = self.queued, sum(self.ongoing.values())
+            demand = queued + ongoing
+            self._queue_gauge.set(queued)
+            self._ongoing_gauge.set(ongoing)
             if demand == 0 and last in (0, None):
                 last = 0
                 continue
@@ -335,6 +350,11 @@ class _ReplicaSet:
 
     def close(self):
         self._closed = True
+        # Zero the demand gauges: the registry is process-global and the
+        # reporter keeps shipping last-set values — a closed handle must not
+        # leave phantom queued/ongoing demand on /metrics forever.
+        self._queue_gauge.set(0)
+        self._ongoing_gauge.set(0)
 
 
 class DeploymentResponse:

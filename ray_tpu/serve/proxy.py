@@ -335,6 +335,22 @@ class ProxyActor:
         writer.write(b"0\r\n\r\n")
         await writer.drain()
 
+    def _dispatch(self, method: str, target: str, headers: dict, body: bytes):
+        """Entry for every HTTP request (thread pool). Tracing: a ROOT span
+        per request when enabled process-wide (tracing.set_trace_enabled /
+        RAYTPU_TRACE=1) or per-request via an ``x-trace`` header; the span's
+        context then rides the handle->replica actor call and every nested
+        task, stitching the whole fan-out into one trace. Untraced requests
+        pay one contextvar-free boolean check."""
+        from ray_tpu.util import tracing
+
+        if not (tracing.trace_enabled()
+                or headers.get("x-trace", "") in ("1", "true", "on")):
+            return self._dispatch_inner(method, target, headers, body)
+        with tracing.span("serve.request", method=method,
+                          path=urlsplit(target).path or "/"):
+            return self._dispatch_inner(method, target, headers, body)
+
     # -- routing (runs on thread pool) -------------------------------------
     def _route_table(self) -> dict:
         now = time.time()
@@ -350,7 +366,7 @@ class ProxyActor:
                 self._routes_at = now  # back off; serve stale table
         return self._routes
 
-    def _dispatch(self, method: str, target: str, headers: dict, body: bytes):
+    def _dispatch_inner(self, method: str, target: str, headers: dict, body: bytes):
         parts = urlsplit(target)
         path = parts.path or "/"
         if path == "/-/healthz":

@@ -14,6 +14,9 @@ import time
 import traceback
 from typing import Any
 
+from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
+
 
 class Replica:
     """Generic replica actor body (created by the ServeController)."""
@@ -35,6 +38,20 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._started_at = time.time()
+        # Per-deployment runtime metrics (reporter -> controller -> /metrics):
+        # request latency histogram + request counter, tagged by app/deployment
+        # so multi-app clusters stay separable on the Prometheus side.
+        tags = {"app": app_name, "deployment": deployment_name}
+        self._latency = _metrics.Histogram(
+            "serve.request.latency_s",
+            "serve request latency per deployment (seconds)",
+            boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30],
+            tag_keys=("app", "deployment"),
+        ).bind(tags)
+        self._requests = _metrics.Counter(
+            "serve.requests", "serve requests handled per deployment",
+            tag_keys=("app", "deployment"),
+        ).set_default_tags(tags)
         if isinstance(user_callable, type):
             self._instance = user_callable(*init_args, **init_kwargs)
             self._is_function = False
@@ -63,9 +80,16 @@ class Replica:
             self._ongoing += 1
             self._total += 1
         token = _set_model_id(model_id) if model_id else None
+        t0 = time.perf_counter()
         try:
-            return self._resolve_fn(method)(*args, **kwargs)
+            # child_span: a no-op unless the caller's trace context arrived
+            # with the actor call (proxy/handle root span).
+            with _tracing.child_span(f"serve.replica.{self.deployment_name}",
+                                     method=method or "__call__"):
+                return self._resolve_fn(method)(*args, **kwargs)
         finally:
+            self._latency.observe(time.perf_counter() - t0)
+            self._requests.inc()
             if token is not None:
                 from ray_tpu.serve.multiplex import _model_id_ctx
 
@@ -87,15 +111,20 @@ class Replica:
             self._ongoing += 1
             self._total += 1
         token = _set_model_id(model_id) if model_id else None
+        t0 = time.perf_counter()
         try:
-            out = self._resolve_fn(method)(*args, **kwargs)
-            if not inspect.isgenerator(out) and not hasattr(out, "__next__"):
-                raise TypeError(
-                    f"deployment {self.deployment_name}.{method or '__call__'} was called "
-                    f"with stream=True but returned {type(out).__name__}, not a generator"
-                )
-            yield from out
+            with _tracing.child_span(f"serve.replica.{self.deployment_name}",
+                                     method=method or "__call__", stream=True):
+                out = self._resolve_fn(method)(*args, **kwargs)
+                if not inspect.isgenerator(out) and not hasattr(out, "__next__"):
+                    raise TypeError(
+                        f"deployment {self.deployment_name}.{method or '__call__'} was called "
+                        f"with stream=True but returned {type(out).__name__}, not a generator"
+                    )
+                yield from out
         finally:
+            self._latency.observe(time.perf_counter() - t0)
+            self._requests.inc()
             if token is not None:
                 _model_id_ctx.reset(token)
             with self._lock:
@@ -115,16 +144,21 @@ class Replica:
             self._ongoing += 1
             self._total += 1
         token = _set_model_id(model_id) if model_id else None
+        t0 = time.perf_counter()
         try:
-            out = self._resolve_fn(method)(*args, **kwargs)
-            if inspect.isgenerator(out) or (
-                hasattr(out, "__next__") and not isinstance(out, (str, bytes))
-            ):
-                for item in out:
-                    yield ("chunk", item)
-            else:
-                yield ("value", out)
+            with _tracing.child_span(f"serve.replica.{self.deployment_name}",
+                                     method=method or "__call__", proxy=True):
+                out = self._resolve_fn(method)(*args, **kwargs)
+                if inspect.isgenerator(out) or (
+                    hasattr(out, "__next__") and not isinstance(out, (str, bytes))
+                ):
+                    for item in out:
+                        yield ("chunk", item)
+                else:
+                    yield ("value", out)
         finally:
+            self._latency.observe(time.perf_counter() - t0)
+            self._requests.inc()
             if token is not None:
                 _model_id_ctx.reset(token)
             with self._lock:

@@ -78,14 +78,35 @@ class Histogram(_Metric):
         self.boundaries = sorted(boundaries or [0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60])
 
     def observe(self, value: float, tags: Optional[dict] = None):
-        s = self._series(tags)
-        with _lock:
-            if s.counts is None:
-                s.buckets = list(self.boundaries)
-                s.counts = [0] * (len(self.boundaries) + 1)
-            s.counts[bisect.bisect_left(s.buckets, value)] += 1
-            s.sum += value
-            s.n += 1
+        _observe_locked(self._series(tags), value)
+
+    def bind(self, tags: Optional[dict] = None) -> "_BoundHistogram":
+        """Pre-resolve a tag set to its series: per-observe cost drops to a
+        bisect under the lock (no tag-dict merge/sort) — for hot paths that
+        record every task/request."""
+        return _BoundHistogram(self._series(tags))
+
+
+def _observe_locked(s: "_Series", value: float):
+    """The one histogram-record implementation (Histogram.observe and every
+    bound series share it)."""
+    with _lock:
+        if s.counts is None:
+            s.buckets = list(s.metric.boundaries)
+            s.counts = [0] * (len(s.buckets) + 1)
+        s.counts[bisect.bisect_left(s.buckets, value)] += 1
+        s.sum += value
+        s.n += 1
+
+
+class _BoundHistogram:
+    __slots__ = ("_series",)
+
+    def __init__(self, series: "_Series"):
+        self._series = series
+
+    def observe(self, value: float):
+        _observe_locked(self._series, value)
 
 
 def snapshot() -> list[dict]:
@@ -94,6 +115,8 @@ def snapshot() -> list[dict]:
     out = []
     with _lock:
         for (_name, _tags), s in _registry.items():
+            if s.metric.KIND == "histogram" and s.counts is None:
+                continue  # bound but never observed: no data to ship
             rec = {
                 "name": s.metric.name,
                 "kind": s.metric.KIND,
@@ -122,10 +145,16 @@ def _esc(value) -> str:
 
 
 def prometheus_text(series: list[dict]) -> str:
-    """Render aggregated series in Prometheus exposition format."""
+    """Render aggregated series in Prometheus exposition format.
+
+    Samples are grouped by metric name first: the exposition format requires
+    every sample of a metric to sit contiguously under a single HELP/TYPE
+    header, and the merged-series dict a controller hands us can interleave
+    different metrics' samples (multi-reporter merge order)."""
     lines = []
     seen_help = set()
-    for rec in series:
+    # Stable sort: groups by name, preserves each metric's series order.
+    for rec in sorted(series, key=lambda r: r["name"]):
         name = "raytpu_" + rec["name"].replace(".", "_").replace("-", "_")
         if name not in seen_help:
             help_text = str(rec.get("description", "")).replace("\\", "\\\\").replace("\n", "\\n")
@@ -136,14 +165,13 @@ def prometheus_text(series: list[dict]) -> str:
         label_str = "{" + labels + "}" if labels else ""
         if rec["kind"] == "histogram":
             acc = 0
-            for b, c in zip(rec["buckets"], rec["counts"]):
-                acc += c
-                sep = "," if labels else ""
-                lines.append(f'{name}_bucket{{{labels}{sep}le="{b}"}} {acc}')
-            total = sum(rec["counts"])
             sep = "," if labels else ""
+            for b, c in zip(rec.get("buckets") or (), rec.get("counts") or ()):
+                acc += c
+                lines.append(f'{name}_bucket{{{labels}{sep}le="{b}"}} {acc}')
+            total = sum(rec.get("counts") or ())
             lines.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {total}')
-            lines.append(f"{name}_sum{label_str} {rec['sum']}")
+            lines.append(f"{name}_sum{label_str} {rec.get('sum', 0.0)}")
             lines.append(f"{name}_count{label_str} {total}")
         else:
             lines.append(f"{name}{label_str} {rec['value']}")

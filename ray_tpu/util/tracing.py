@@ -1,4 +1,4 @@
-"""Tracing & profiling: task timeline export + TPU profiler capture.
+"""Tracing & profiling: distributed spans, task timeline export + TPU profiler.
 
 Role-equivalent to the reference's tracing stack (SURVEY §5): the C++
 TaskEventBuffer -> GcsTaskManager -> `ray timeline` pipeline
@@ -7,13 +7,149 @@ shipped with the metrics reporter and aggregated on the controller; the
 py-spy/nsight on-demand profilers become the JAX profiler (XPlane/Perfetto)
 — the right tool on TPU (dashboard/modules/reporter/profile_manager.py is
 GPU/CPU-process oriented).
+
+Distributed tracing (this module's Span API): a trace context
+``(trace_id, span_id)`` rides a contextvar inside one process and the
+task-spec / call payloads across processes (core/worker.py attaches the
+caller's active context to every submitted task; the executor re-activates
+it around user code). Every cross-process hop — task submission, actor
+calls, serve handle -> proxy -> replica, compiled-DAG pushes, the LLM
+engine — therefore stitches into ONE trace with parent/child span links,
+aggregated on the controller (indexable via ``get_trace``/``list_traces``
+and the dashboard's ``/api/traces``) and rendered by ``export_timeline``
+as connected chrome-trace lanes with flow arrows (``ph: s/f``) across
+process boundaries.
+
+Cost contract: with no span active the ONLY per-call cost anywhere on the
+hot path is one ``ContextVar.get`` returning None (guards sit before any
+dict building or id minting); ``child_span`` is a no-op then. Creating a
+root span is explicit (``span(...)`` or the serve proxy's ``x-trace``
+header / ``set_trace_enabled``).
 """
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import json
+import os
 import time
 from typing import Optional
+
+# The active trace context of this thread/task: (trace_id, span_id) or None.
+_ctx: contextvars.ContextVar[Optional[tuple]] = contextvars.ContextVar(
+    "raytpu_trace_ctx", default=None
+)
+
+# Process-wide default for auto-root spans (serve proxy ingress): off by
+# default so the serving hot path pays nothing unless asked.
+_trace_all = os.environ.get("RAYTPU_TRACE", "") in ("1", "true", "on")
+
+
+def set_trace_enabled(on: bool):
+    """Enable auto-root spans for ingress points that support them (the
+    serve HTTP proxy traces every request when on; individual requests can
+    also opt in with an ``x-trace: 1`` header)."""
+    global _trace_all
+    _trace_all = bool(on)
+
+
+def trace_enabled() -> bool:
+    return _trace_all
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(4).hex()
+
+
+def current_trace() -> Optional[tuple]:
+    """The active (trace_id, span_id) of this thread/task, or None. This is
+    what cross-process propagation attaches to outgoing payloads."""
+    return _ctx.get()
+
+
+def activate(ctx: Optional[tuple]):
+    """Install a propagated (trace_id, span_id) as this thread's active
+    context; returns a token for ``deactivate``. None -> no-op (None token)."""
+    if ctx is None:
+        return None
+    return _ctx.set((ctx[0], ctx[1]))
+
+
+def deactivate(token):
+    if token is not None:
+        _ctx.reset(token)
+
+
+def _record_event(ev: dict):
+    """Append a span event to this process's task-event buffer (ships to the
+    controller with the metrics reporter). No core worker -> dropped."""
+    from ray_tpu.core import api
+
+    core = api._global_worker
+    if core is not None:
+        core._event("span", **ev)
+
+
+class Span:
+    """One timed span. Context manager; re-entrant use is NOT supported
+    (create a new Span per block). On exit records a single ``span`` task
+    event carrying (trace_id, span_id, parent_id, name, start, dur)."""
+
+    __slots__ = ("name", "attrs", "trace_id", "span_id", "parent_id", "_token", "_t0")
+
+    def __init__(self, name: str, attrs: Optional[dict] = None):
+        self.name = name
+        self.attrs = attrs
+        parent = _ctx.get()
+        if parent is None:
+            self.trace_id = new_trace_id()
+            self.parent_id = ""
+        else:
+            self.trace_id = parent[0]
+            self.parent_id = parent[1]
+        self.span_id = new_span_id()
+        self._token = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> "Span":
+        self._token = _ctx.set((self.trace_id, self.span_id))
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _ctx.reset(self._token)
+        ev = {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "ts": self._t0,
+            "dur": time.time() - self._t0,
+        }
+        if self.attrs:
+            ev["attrs"] = self.attrs
+        if exc_type is not None:
+            ev["error"] = exc_type.__name__
+        _record_event(ev)
+        return False
+
+
+def span(name: str, **attrs) -> Span:
+    """Start a span (new root trace if none is active)."""
+    return Span(name, attrs or None)
+
+
+def child_span(name: str, **attrs):
+    """A span ONLY when a trace is already active, else a free no-op — the
+    form internal subsystems (LLM engine, serve replica) use so untraced
+    hot paths pay a single contextvar read."""
+    if _ctx.get() is None:
+        return contextlib.nullcontext()
+    return Span(name, attrs or None)
 
 
 def get_task_events(limit: int = 20000) -> list[dict]:
@@ -27,10 +163,48 @@ def get_task_events(limit: int = 20000) -> list[dict]:
     return core._run(core.controller.call("get_task_events", {"limit": limit}))
 
 
+def get_trace(trace_id: str) -> list[dict]:
+    """All events recorded under one trace id, cluster-wide, time-ordered.
+
+    Staleness window: only THIS process's buffer is flushed on demand;
+    events recorded on other workers arrive with their periodic reporter
+    tick (metrics_report_interval_s, default 5s). Poll until the expected
+    hops appear when reading a trace immediately after the request."""
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    core._run(core._flush_task_events())
+    return core._run(core.controller.call("get_trace", {"trace_id": trace_id}))
+
+
+def list_traces(limit: int = 100, q: str = "") -> list[dict]:
+    """Recent traces: [{trace_id, name, start, dur, spans, workers}];
+    ``q`` filters by trace id prefix or root-span name substring. Same
+    staleness window as get_trace: remote workers' spans land on their
+    reporter tick, so a just-finished request may list incomplete."""
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    core._run(core._flush_task_events())
+    return core._run(core.controller.call("list_traces", {"limit": limit, "q": q}))
+
+
+def _flow_id(task_id: str) -> int:
+    """Stable numeric flow-event id from a task id (chrome trace ids are
+    uint64; 15 hex chars keeps it comfortably in range)."""
+    return int(task_id[:15] or "0", 16)
+
+
 def export_timeline(path: str, limit: int = 20000) -> int:
     """Write a chrome://tracing-format timeline of task execution across the
     cluster (the `ray timeline` equivalent). Returns the number of trace
-    events written."""
+    events written.
+
+    Events carrying a trace context additionally emit flow events
+    (``ph: "s"`` at submission on the caller's lane, ``ph: "f"`` at
+    execution start on the executor's lane) so one request renders as a
+    connected arrow chain across processes, and ``span`` events (the Span
+    API) render as their own slices."""
     events = get_task_events(limit)
     trace: list[dict] = []
     open_spans: dict[tuple, dict] = {}  # (worker, task_id) -> start event
@@ -38,11 +212,47 @@ def export_timeline(path: str, limit: int = 20000) -> int:
         kind = ev.get("kind", "")
         worker = ev.get("worker", "?")
         ts_us = ev["ts"] * 1e6
-        if kind == "task_exec_start":
+        if kind == "span":
+            trace.append({
+                "name": ev.get("name", "span"),
+                "cat": "span",
+                "ph": "X",
+                "ts": ts_us,
+                "dur": max(1.0, ev.get("dur", 0.0) * 1e6),
+                "pid": worker,
+                "tid": "span",
+                "args": {
+                    "trace_id": ev.get("trace_id"),
+                    "span_id": ev.get("span_id"),
+                    "parent_id": ev.get("parent_id"),
+                    **(ev.get("attrs") or {}),
+                },
+            })
+        elif kind == "task_exec_start":
             open_spans[(worker, ev.get("task_id"))] = ev
+            if ev.get("trace_id"):
+                # Flow arrival: binds this execution to its submission arrow.
+                trace.append({
+                    "name": "task_flow",
+                    "cat": "flow",
+                    "ph": "f",
+                    "bp": "e",
+                    "id": _flow_id(ev.get("task_id", "")),
+                    "ts": ts_us,
+                    "pid": worker,
+                    "tid": "exec",
+                    "args": {"trace_id": ev["trace_id"]},
+                })
         elif kind == "task_exec_end":
             start = open_spans.pop((worker, ev.get("task_id")), None)
             if start is not None:
+                args = {"task_id": ev.get("task_id")}
+                if start.get("trace_id"):
+                    args.update(
+                        trace_id=start["trace_id"],
+                        span_id=start.get("span_id"),
+                        parent_id=start.get("parent_id"),
+                    )
                 trace.append({
                     "name": start.get("fn") or ev.get("task_id", "task")[:8],
                     "cat": "task",
@@ -51,9 +261,21 @@ def export_timeline(path: str, limit: int = 20000) -> int:
                     "dur": max(1.0, ts_us - start["ts"] * 1e6),
                     "pid": worker,
                     "tid": "exec",
-                    "args": {"task_id": ev.get("task_id")},
+                    "args": args,
                 })
         elif kind in ("task_submitted", "object_recovery", "task_finished"):
+            if kind == "task_submitted" and ev.get("trace_id"):
+                # Flow departure: the submission side of the cross-process arrow.
+                trace.append({
+                    "name": "task_flow",
+                    "cat": "flow",
+                    "ph": "s",
+                    "id": _flow_id(ev.get("task_id", "")),
+                    "ts": ts_us,
+                    "pid": worker,
+                    "tid": "control",
+                    "args": {"trace_id": ev["trace_id"]},
+                })
             trace.append({
                 "name": kind,
                 "cat": "control",
