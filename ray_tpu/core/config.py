@@ -46,6 +46,27 @@ class Config:
     object_store_memory: int = 256 * 1024 * 1024
     object_chunk_size: int = 1024 * 1024
     object_spill_dir: str = ""
+    # --- object transfer plane (PullManager, node.py) ---
+    # Chunks kept in flight per pulled object: fills the bandwidth-delay
+    # product instead of stop-and-wait (reference: ObjectManager pipelined
+    # chunk reads, max_chunks_in_flight).
+    pull_window_chunks: int = 8
+    # Transfer-plane chunk size (raw-lane pulls). Larger than
+    # object_chunk_size on purpose: the streaming lane's per-chunk fixed
+    # cost (request envelope, ack, admission, frame headers) is pure
+    # overhead, and with windowed pipelining + per-chunk failover a 4 MiB
+    # retry unit is still cheap. object_chunk_size (1 MiB) remains the
+    # legacy pickled-chunk and inline-promotion threshold.
+    pull_chunk_size: int = 4 * 1024 * 1024
+    # Global pull admission: whole-object pulls admitted concurrently per
+    # daemon, and total chunk bytes in flight across them — bulk transfer
+    # must not starve the control plane (reference: PullManager admission
+    # by available object-store memory).
+    max_concurrent_pulls: int = 4
+    max_inflight_pull_bytes: int = 64 * 1024 * 1024
+    # Per-chunk deadline; on expiry the source connection is dropped (it may
+    # be mid-frame) and the chunk retries against an alternate replica.
+    pull_chunk_timeout_s: float = 30.0
     # --- workers ---
     num_workers_soft_limit: int = 0  # 0 => num_cpus
     worker_register_timeout_s: float = 30.0

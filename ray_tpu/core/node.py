@@ -11,6 +11,7 @@ object_manager.h:128, PullManager/PushManager with 1MB chunking).
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import os
 import subprocess
@@ -23,6 +24,8 @@ from ray_tpu.core import rpc
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryClient
+from ray_tpu.util import tracing as _tracing
+from ray_tpu.util.bgtasks import spawn_bg as _spawn_bg_task
 
 logger = logging.getLogger(__name__)
 
@@ -78,7 +81,19 @@ class NodeDaemon:
         # idle cache keyed by runtime-env hash).
         self.idle_workers: dict[str, list[WorkerRecord]] = {}
         self._spawn_env = dict(env or {})
-        self._pulls: dict[bytes, asyncio.Future] = {}
+        # Streaming transfer plane: pipelined multi-source pulls with global
+        # admission (reference: ObjectManager + PullManager).
+        self.pull_manager = PullManager(self)
+        # Long-lived peer daemon connections, reused across pulls instead of
+        # dialing per object (reference: ObjectManager connection pool).
+        self._peer_conns: dict[str, rpc.Connection] = {}
+        # Spilled-object read cache: oid -> [fd, last_used]; one open() per
+        # object per transfer session, chunks served with pread.
+        self._spill_fds: dict[bytes, list] = {}
+        # Strong refs to fire-and-forget tasks (asyncio tracks tasks weakly;
+        # an unreferenced task can be GC'd mid-await — the init-task bug class).
+        self._misc_tasks: set[asyncio.Task] = set()
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._bg: list[asyncio.Task] = []
         self.address = ""
         # Per-node worker log files, tailed by the LogMonitor task and
@@ -86,8 +101,18 @@ class NodeDaemon:
         self.log_dir = os.path.join(self.session_dir, "logs", self.node_id[:12])
         self._log_monitor = None
 
+    def _spawn_bg(self, coro) -> asyncio.Task:
+        """create_task with a strong reference held until completion. Every
+        fire-and-forget task in this daemon must go through here: asyncio
+        keeps only weak refs, and a gc cycle landing mid-await kills an
+        unreferenced task with GeneratorExit (observed as lost sealed-object
+        reports and never-reported worker deaths)."""
+        loop = self._loop if self._loop is not None else asyncio.get_running_loop()
+        return _spawn_bg_task(self._misc_tasks, coro, loop=loop)
+
     # ------------------------------------------------------------------
     async def start(self, port: int = 0) -> str:
+        self._loop = asyncio.get_running_loop()
         # TPU autodetection: a daemon on a TPU host advertises chips + slice
         # labels exactly like the reference's TPUAcceleratorManager feeds the
         # raylet resource/label config (python/ray/_private/accelerators/tpu.py).
@@ -116,6 +141,7 @@ class NodeDaemon:
         await self.controller.ensure()
         self._bg.append(asyncio.create_task(self._heartbeat_loop()))
         self._bg.append(asyncio.create_task(self._idle_reaper_loop()))
+        self._bg.append(asyncio.create_task(self._transfer_metrics_loop()))
         from ray_tpu.log_monitor import LogMonitor
 
         async def _publish_logs(batch: dict):
@@ -140,8 +166,22 @@ class NodeDaemon:
     async def stop(self):
         for t in self._bg:
             t.cancel()
+        for t in list(self._misc_tasks):
+            t.cancel()
         for w in list(self.workers.values()):
             self._kill_worker_proc(w, "daemon shutdown")
+        for conn in list(self._peer_conns.values()):
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        self._peer_conns.clear()
+        for fd, _ts in self._spill_fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._spill_fds.clear()
         await self.server.close()
         if self.controller:
             await self.controller.close()
@@ -203,6 +243,29 @@ class NodeDaemon:
                     if now - w.last_idle_ts > self.config.idle_worker_killing_time_s:
                         pool.remove(w)
                         self._kill_worker_proc(w, "idle timeout")
+            for key, (fd, ts) in list(self._spill_fds.items()):
+                if now - ts > 60.0:  # transfer session over: release the fd
+                    self._spill_fds.pop(key, None)
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+
+    async def _transfer_metrics_loop(self):
+        """Ship the transfer plane's counters/gauges/histograms to the
+        controller under this node's own reporter id. The series are built
+        locally by the PullManager (not the process-global metrics registry),
+        so in-process test clusters never double-report them through a
+        co-resident CoreWorker reporter."""
+        while True:
+            await asyncio.sleep(self.config.metrics_report_interval_s)
+            try:
+                await self.controller.notify(
+                    "report_metrics",
+                    {"reporter": f"node:{self.node_id[:12]}", "series": self.pull_manager.metrics_series()},
+                )
+            except Exception:
+                pass
 
     # -- worker pool ----------------------------------------------------
     async def _materialize_env(self, renv: Optional[dict]):
@@ -315,7 +378,7 @@ class NodeDaemon:
         record.state = "IDLE"
         record.state_ts = time.monotonic()
         conn.meta.update(role="worker", worker_id=p["worker_id"])
-        conn.on_close = lambda c, r=record: asyncio.get_event_loop().create_task(self._on_worker_conn_closed(r))
+        conn.on_close = lambda c, r=record: self._spawn_bg(self._on_worker_conn_closed(r))
         if record.ready and not record.ready.done():
             record.ready.set_result(record)
         return {"node_id": self.node_id, "config": self.config.to_dict()}
@@ -417,73 +480,51 @@ class NodeDaemon:
         # so _on_worker_conn_closed won't report — report here or restartable
         # actors (max_restarts) would never leave ALIVE in the controller.
         if not already_dead and record.actor_ids:
-            asyncio.get_event_loop().create_task(self._report_worker_died(record, reason))
+            self._spawn_bg(self._report_worker_died(record, reason))
 
     # -- object plane ---------------------------------------------------
+    async def _peer(self, addr: str) -> rpc.Connection:
+        """Cached daemon-to-daemon connection (dialed once, reused by every
+        pull/chunk to that peer)."""
+        conn = self._peer_conns.get(addr)
+        if conn is not None and not conn.closed:
+            return conn
+        conn = await rpc.connect(addr, handler=None, timeout=2.0, retry=False)
+        cached = self._peer_conns.get(addr)
+        if cached is not None and not cached.closed:
+            # Lost a dial race with a concurrent pull; keep the winner.
+            await conn.close()
+            return cached
+        self._peer_conns[addr] = conn
+        return conn
+
+    async def _drop_peer(self, addr: str, conn: rpc.Connection):
+        """Hard-drop a peer connection (it may be mid-raw-frame writing into
+        a transfer buffer; closing cancels its read loop so a retried chunk
+        can never race a stale writer on the same region)."""
+        if self._peer_conns.get(addr) is conn:
+            self._peer_conns.pop(addr, None)
+        try:
+            await conn.close()
+        except Exception:
+            pass
+
     async def handle_pull_object(self, conn, p):
-        """Ensure the object is in the local store, pulling from a remote node
+        """Ensure the object is in the local store, pulling from remote nodes
         if needed (reference: PullManager admission + chunked transfer)."""
         oid = ObjectID(p["oid"])
         if self.store.contains(oid):
             return {"ok": True}
         if self._restore_local(oid):  # spilled locally: restore beats a network pull
             return {"ok": True}
-        key = oid.binary()
-        if key in self._pulls:
-            await self._pulls[key]
-            return {"ok": self.store.contains(oid)}
-        fut = asyncio.get_running_loop().create_future()
-        self._pulls[key] = fut
+        token = _tracing.activate(tuple(p["tc"])) if p.get("tc") else None
         try:
-            ok = await self._do_pull(oid, p.get("locations"))
-            fut.set_result(ok)
+            ok = await self.pull_manager.pull(oid, p.get("locations"))
             return {"ok": ok}
         except Exception as e:
-            fut.set_result(False)
             return {"ok": False, "error": str(e)}
         finally:
-            self._pulls.pop(key, None)
-
-    async def _do_pull(self, oid: ObjectID, locations=None) -> bool:
-        if locations is None:
-            locations = await self.controller.call("lookup_object", {"oid": oid.binary()})
-        locations = [loc for loc in locations if loc["node_id"] != self.node_id]
-        for loc in locations:
-            try:
-                src = await rpc.connect(loc["address"], handler=None, timeout=2.0, retry=False)
-            except Exception:
-                continue
-            try:
-                info = await src.call("object_info", {"oid": oid.binary()})
-                if not info:
-                    continue
-                size = info["size"]
-                buf, evicted = self.store.create_autoevict(oid, size)
-                if evicted:
-                    await self.controller.notify(
-                        "report_objects_evicted", {"oids": [o.binary() for o in evicted], "node_id": self.node_id}
-                    )
-                try:
-                    chunk = self.config.object_chunk_size
-                    off = 0
-                    while off < size:
-                        data = await src.call("read_object_chunk", {"oid": oid.binary(), "offset": off, "length": min(chunk, size - off)})
-                        buf[off : off + len(data)] = data
-                        off += len(data)
-                    self.store.seal(oid)
-                finally:
-                    del buf
-                await self.controller.notify("report_object", {"oid": oid.binary(), "node_id": self.node_id, "size": size})
-                return True
-            except Exception as e:
-                logger.warning("pull %s from %s failed: %s", oid.hex()[:10], loc["node_id"][:8], e)
-                try:
-                    self.store.delete(oid)
-                except Exception:
-                    pass
-            finally:
-                await src.close()
-        return False
+            _tracing.deactivate(token)
 
     def _restore_local(self, oid: ObjectID) -> bool:
         """Restore a spilled object into the arena, reporting any objects
@@ -491,7 +532,7 @@ class NodeDaemon:
         evicted: list = []
         ok = self.store.restore(oid, evicted_out=evicted)
         if evicted:
-            asyncio.get_event_loop().create_task(
+            self._spawn_bg(
                 self.controller.notify(
                     "report_objects_evicted", {"oids": [o.binary() for o in evicted], "node_id": self.node_id}
                 )
@@ -511,13 +552,78 @@ class NodeDaemon:
         self.store.release(oid)
         return {"size": size}
 
+    def _spilled_pread(self, oid: ObjectID, offset: int, length: int) -> bytes | None:
+        """Ranged read of a spilled object through a per-object cached fd:
+        ONE open per transfer session instead of a path resolve + open per
+        chunk; pread needs no seek state so concurrent chunks can share the
+        fd. The reaper closes fds idle >60s; delete closes eagerly."""
+        if not self.store.spill_dir:
+            return None
+        key = oid.binary()
+        ent = self._spill_fds.get(key)
+        if ent is None:
+            try:
+                fd = os.open(os.path.join(self.store.spill_dir, oid.hex()), os.O_RDONLY)
+            except OSError:
+                return None
+            ent = self._spill_fds[key] = [fd, 0.0]
+        ent[1] = time.monotonic()
+        try:
+            return os.pread(ent[0], length, offset)
+        except OSError:
+            return None
+
+    def _close_spill_fd(self, oid: ObjectID):
+        ent = self._spill_fds.pop(oid.binary(), None)
+        if ent is not None:
+            try:
+                os.close(ent[0])
+            except OSError:
+                pass
+
+    async def handle_read_object_chunk_raw(self, conn, p):
+        """Serve one chunk on the raw lane: the payload is an arena
+        memoryview slice (or a spilled pread) written straight to the wire —
+        no bytes() copy, no pickle (reference: ObjectManager chunked Push).
+        The reply is a tiny ack that can coalesce with other replies."""
+        oid = ObjectID(p["oid"])
+        offset, length = p["offset"], p["length"]
+        view = self.store.get(oid)
+        if view is None and self._restore_local(oid):  # restore once, stream from arena
+            view = self.store.get(oid)
+        if view is None:
+            data = self._spilled_pread(oid, offset, length)
+            if data is None:
+                raise KeyError(f"object {oid.hex()} not in store")
+            if len(data) != length:
+                # Fail loud with the real cause: shipping the short payload
+                # would make the receiver discard it as a size mismatch and
+                # retry this same truncated file until the source is declared
+                # dead, burying "spill file truncated" under generic errors.
+                raise OSError(
+                    f"truncated spill read for {oid.hex()}: wanted {length} at +{offset}, got {len(data)}"
+                )
+            await conn.send_raw(p["key"], data)
+            self.pull_manager.bytes_out += length
+            return True
+        try:
+            sl = view[offset : offset + length]
+            await conn.send_raw(p["key"], sl)
+            self.pull_manager.bytes_out += len(sl)
+            return True
+        finally:
+            view.release()
+            self.store.release(oid)
+
     def handle_read_object_chunk(self, conn, p):
+        """Legacy pickled chunk read (pre-v3 pull path; kept for tooling and
+        as the raw lane's functional reference)."""
         oid = ObjectID(p["oid"])
         view = self.store.get(oid)
         if view is None and self._restore_local(oid):
             view = self.store.get(oid)
         if view is None:
-            data = self.store.read_spilled_range(oid, p["offset"], p["length"])
+            data = self._spilled_pread(oid, p["offset"], p["length"])
             if data is not None:
                 return data
             raise KeyError(f"object {oid.hex()} not in store")
@@ -529,14 +635,14 @@ class NodeDaemon:
 
     def handle_delete_objects(self, conn, p):
         for oid_bin in p["oids"]:
-            self.store.delete(ObjectID(oid_bin), drop_spilled=True)
+            oid = ObjectID(oid_bin)
+            self._close_spill_fd(oid)
+            self.store.delete(oid, drop_spilled=True)
         return True
 
     def handle_report_sealed(self, conn, p):
         # Worker sealed an object locally; forward the location to the directory.
-        asyncio.create_task(
-            self._report_sealed(p)
-        )
+        self._spawn_bg(self._report_sealed(p))
         return True
 
     async def _report_sealed(self, p):
@@ -547,6 +653,358 @@ class NodeDaemon:
 
     def handle_store_stats(self, conn, p):
         return {"capacity": self.store.capacity, "used": self.store.used, "num_objects": self.store.num_objects}
+
+
+class _LocalHist:
+    """Tiny daemon-local histogram accumulator emitting snapshot()-shaped
+    records. Deliberately NOT the process-global metrics registry: in-process
+    test clusters co-host daemons with a CoreWorker whose reporter ships that
+    registry — daemon series must ride the daemon's own reporter id only."""
+
+    __slots__ = ("buckets", "counts", "sum", "n")
+
+    def __init__(self, buckets: list):
+        self.buckets = list(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.n = 0
+
+    def observe(self, value: float):
+        i = 0
+        while i < len(self.buckets) and value > self.buckets[i]:
+            i += 1
+        self.counts[i] += 1
+        self.sum += value
+        self.n += 1
+
+    def record(self, name: str, desc: str, ts: float) -> dict:
+        return {
+            "name": name, "kind": "histogram", "description": desc,
+            "tags": {}, "value": 0.0, "ts": ts,
+            "buckets": list(self.buckets), "counts": list(self.counts),
+            "sum": self.sum, "n": self.n,
+        }
+
+
+class PullManager:
+    """Pipelined, multi-source object pulls (reference: ObjectManager +
+    PullManager, object_manager.h:128).
+
+    Per object: a window of K chunks in flight (fills the bandwidth-delay
+    product instead of stop-and-wait), chunk ranges striped across every
+    replica the directory returns, and per-chunk failover — a failed chunk
+    retries against an alternate source instead of restarting the object.
+    Globally: admission caps (concurrent pulls, inflight bytes) so bulk
+    transfer cannot starve the control plane, concurrent pulls of one oid
+    coalesce onto a single transfer, and peer connections are reused from
+    the daemon's cache. Chunks move on the rpc raw lane: never pickled,
+    recv'd straight into the arena buffer at the chunk's offset."""
+
+    def __init__(self, daemon: "NodeDaemon"):
+        self.daemon = daemon
+        self._pulls: dict[bytes, asyncio.Future] = {}
+        self._sem: asyncio.Semaphore | None = None  # lazily: needs the loop
+        self._byte_waiters: collections.deque = collections.deque()
+        self._inflight_bytes = 0
+        self._inflight_pulls = 0
+        # Counters (plain ints on the hot path; shipped by metrics_series).
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.pulls_ok = 0
+        self.pulls_failed = 0
+        self.chunks_retried = 0
+        self._lat = _LocalHist([0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120])
+        self._mbs = _LocalHist([1, 4, 16, 64, 256, 1024, 4096])
+        # Last completed pull's shape, for bench detail / debugging.
+        self.last_pull: dict = {}
+
+    def _ensure_primitives(self):
+        if self._sem is None:
+            self._sem = asyncio.Semaphore(max(1, self.daemon.config.max_concurrent_pulls))
+
+    # -- admission ------------------------------------------------------
+    # Byte budget without a Condition: single-threaded on the daemon loop, so
+    # the uncontended path is a plain counter bump (no lock round trip per
+    # chunk) and waiters park on bare futures that release wakes.
+    async def _acquire_bytes(self, n: int):
+        budget = max(1, self.daemon.config.max_inflight_pull_bytes)
+        # A single chunk larger than the whole budget still admits when
+        # nothing else is in flight (no deadlock on huge chunk sizes).
+        while not (self._inflight_bytes == 0 or self._inflight_bytes + n <= budget):
+            fut = asyncio.get_running_loop().create_future()
+            self._byte_waiters.append(fut)
+            await fut
+        self._inflight_bytes += n
+
+    def _release_bytes(self, n: int):
+        self._inflight_bytes -= n
+        while self._byte_waiters:
+            fut = self._byte_waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)  # wake all; each re-checks the budget
+
+    # -- public entry ---------------------------------------------------
+    async def pull(self, oid: ObjectID, locations=None) -> bool:
+        """Pull ``oid`` into the local arena. Concurrent calls for the same
+        oid coalesce onto one transfer (everyone awaits the same future)."""
+        self._ensure_primitives()
+        key = oid.binary()
+        fut = self._pulls.get(key)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls[key] = fut
+        ok = False
+        try:
+            ok = await self._pull_once(oid, locations)
+        except Exception as e:
+            logger.warning("pull %s failed: %s", oid.hex()[:10], e)
+        finally:
+            self._pulls.pop(key, None)
+            if not fut.done():
+                fut.set_result(ok)
+        return ok
+
+    async def _pull_once(self, oid: ObjectID, locations) -> bool:
+        d = self.daemon
+        if d.store.contains(oid):
+            return True
+        hinted = locations is not None
+        if not hinted:
+            locations = await d.controller.call("lookup_object", {"oid": oid.binary()})
+        sources = [dict(loc) for loc in (locations or []) if loc["node_id"] != d.node_id]
+        if not sources and not hinted:
+            return False
+        t0 = time.monotonic()
+        self._inflight_pulls += 1
+        ok = False
+        try:
+            with _tracing.child_span("object.pull", oid=oid.hex()[:16]):
+                async with self._sem:  # pull admission
+                    try:
+                        ok = bool(sources) and await self._transfer(oid, sources, t0)
+                    except Exception:
+                        if not hinted:
+                            raise
+                        ok = False  # hinted sources died mid-transfer: ask the directory
+                    if not ok and hinted:
+                        # Owner hints are an optimization, not the truth:
+                        # the hinted replica may be dead or evicted while
+                        # the directory knows a live copy elsewhere (any
+                        # earlier puller reported it). One fallback lookup,
+                        # excluding sources that just failed.
+                        tried = {s["node_id"] for s in sources}
+                        fresh = await d.controller.call("lookup_object", {"oid": oid.binary()})
+                        alt = [
+                            dict(loc) for loc in (fresh or [])
+                            if loc["node_id"] != d.node_id and loc["node_id"] not in tried
+                        ]
+                        if alt:
+                            ok = await self._transfer(oid, alt, t0)
+        finally:
+            # In the finally so an exception exit still counts as a failed
+            # pull — the failed counter exists precisely for those.
+            self._inflight_pulls -= 1
+            if ok:
+                self.pulls_ok += 1
+            else:
+                self.pulls_failed += 1
+        return ok
+
+    async def _transfer(self, oid: ObjectID, sources: list, t0: float) -> bool:
+        d = self.daemon
+        cfg = d.config
+        # Probe every advertised replica in parallel; only sources that
+        # actually hold the object (directory entries can be stale) join the
+        # stripe set.
+        async def probe(loc):
+            try:
+                conn = await d._peer(loc["address"])
+                info = await asyncio.wait_for(
+                    conn.call("object_info", {"oid": oid.binary()}), cfg.pull_chunk_timeout_s
+                )
+                return (loc, info["size"]) if info else None
+            except Exception:
+                return None
+
+        probed = [r for r in await asyncio.gather(*(probe(loc) for loc in sources)) if r]
+        if not probed:
+            return False
+        size = probed[0][1]
+        live = [loc for loc, sz in probed if sz == size]
+        chunk = cfg.pull_chunk_size
+        nchunks = (size + chunk - 1) // chunk or 1
+        pending = collections.deque(range(nchunks))
+        retried_before = self.chunks_retried
+        stop = False
+        buf = None
+        try:
+            buf, evicted = d.store.create_autoevict(oid, size)
+            if evicted:
+                await d.controller.notify(
+                    "report_objects_evicted", {"oids": [o.binary() for o in evicted], "node_id": d.node_id}
+                )
+
+            async def window_worker():
+                nonlocal stop
+                while pending and not stop:
+                    i = pending.popleft()
+                    off = i * chunk
+                    ln = min(chunk, size - off)
+                    await self._acquire_bytes(ln)
+                    try:
+                        await self._fetch_chunk(oid, buf, off, ln, live, i)
+                        self.bytes_in += ln
+                    except Exception:
+                        stop = True
+                        raise
+                    finally:
+                        self._release_bytes(ln)
+
+            workers = [
+                asyncio.ensure_future(window_worker())
+                for _ in range(min(max(1, cfg.pull_window_chunks), nchunks))
+            ]
+            results = await asyncio.gather(*workers, return_exceptions=True)
+            errs = [r for r in results if isinstance(r, BaseException)]
+            if errs:
+                raise errs[0]
+            d.store.seal(oid)
+        except BaseException:
+            if buf is not None:
+                # abort(), not delete(): the entry is created-but-unsealed,
+                # and the writer pin makes a plain delete refuse it — the
+                # allocation would leak and ObjectExistsError would poison
+                # every future pull of this oid on this node.
+                try:
+                    d.store.abort(oid)
+                except Exception:
+                    pass
+            raise
+        finally:
+            del buf
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        mb_s = size / elapsed / 1e6
+        self._lat.observe(elapsed)
+        self._mbs.observe(mb_s)
+        self.last_pull = {
+            "size": size,
+            "window": min(max(1, cfg.pull_window_chunks), nchunks),
+            "sources": len(live),
+            "chunks": nchunks,
+            "chunks_retried": self.chunks_retried - retried_before,
+            "mb_s": round(mb_s, 1),
+        }
+        _tracing.event("object.pull.done", size=size, mb_s=round(mb_s, 1))
+        await d.controller.notify(
+            "report_object", {"oid": oid.binary(), "node_id": d.node_id, "size": size}
+        )
+        return True
+
+    async def _fetch_chunk(self, oid: ObjectID, buf, off: int, ln: int, sources: list, idx: int):
+        """Fetch one chunk, striping the initial source by chunk index and
+        failing over to alternates (each failure hard-drops the offending
+        connection: it may be mid-frame into our buffer, and a dead writer
+        must not race the retry on the same region)."""
+        d = self.daemon
+        timeout = d.config.pull_chunk_timeout_s
+        n = len(sources)
+        last_err: Exception | None = None
+        budget = 2 * n  # real failures spend this; collateral drops don't
+        attempt = 0
+        guard = 0
+        while budget > 0 and guard < 8 * n:
+            guard += 1
+            src = sources[(idx + attempt) % n]
+            attempt += 1
+            if src.get("dead"):
+                if all(s.get("dead") for s in sources):
+                    break
+                continue
+            conn = None
+            try:
+                conn = await d._peer(src["address"])
+                key = os.urandom(12)
+                fut = conn.expect_raw(key, buf[off : off + ln])
+                try:
+                    # One deadline over both halves (request ack + payload
+                    # landing); they overlap — the raw frame is usually on
+                    # the wire before the coalesced ack reply.
+                    ack, landed = await asyncio.wait_for(
+                        asyncio.gather(
+                            conn.call(
+                                "read_object_chunk_raw",
+                                {"oid": oid.binary(), "offset": off, "length": ln, "key": key},
+                            ),
+                            fut,
+                        ),
+                        timeout,
+                    )
+                finally:
+                    conn.unexpect_raw(key)
+                if not ack or not landed:
+                    raise rpc.RpcError("chunk transfer failed")
+                return
+            except Exception as e:
+                last_err = e
+                self.chunks_retried += 1
+                # Collateral ConnectionLost: ANOTHER chunk worker already
+                # hard-dropped this connection (it is no longer the cached
+                # one). That is one source problem fanned out across the
+                # whole window — charging it to this source's death budget
+                # would let a single slow chunk kill a healthy
+                # single-replica pull. Redial and retry without spending.
+                collateral = (
+                    isinstance(e, rpc.ConnectionLost)
+                    and conn is not None
+                    and d._peer_conns.get(src["address"]) is not conn
+                )
+                if not collateral:
+                    budget -= 1
+                    src["failures"] = src.get("failures", 0) + 1
+                    if src["failures"] >= 2:
+                        src["dead"] = True
+                _tracing.event(
+                    "object.pull.chunk_retry",
+                    oid=oid.hex()[:16], offset=off, source=src["node_id"][:8],
+                    error=f"{type(e).__name__}: {e}"[:120],
+                )
+                logger.warning(
+                    "chunk %s+%d of %s from %s failed (%s); trying alternate",
+                    off, ln, oid.hex()[:10], src["node_id"][:8], e,
+                )
+                if conn is not None and not collateral:
+                    await d._drop_peer(src["address"], conn)
+            if all(s.get("dead") for s in sources):
+                break
+        raise last_err if last_err is not None else rpc.RpcError("no live sources")
+
+    # -- observability ---------------------------------------------------
+    def metrics_series(self) -> list[dict]:
+        now = time.time()
+        out = []
+
+        def rec(name, kind, value, tags, desc=""):
+            out.append({"name": name, "kind": kind, "description": desc,
+                        "tags": tags, "value": float(value), "ts": now})
+
+        rec("object.transfer.bytes", "counter", self.bytes_in,
+            {"dir": "in"}, "object bytes pulled into this node's arena")
+        rec("object.transfer.bytes", "counter", self.bytes_out,
+            {"dir": "out"}, "object bytes served to remote pullers")
+        rec("object.pull.count", "counter", self.pulls_ok, {"result": "ok"})
+        rec("object.pull.count", "counter", self.pulls_failed, {"result": "failed"})
+        rec("object.pull.chunk_retries", "counter", self.chunks_retried, {},
+            "chunks retried against an alternate source")
+        rec("object.pull.inflight", "gauge", self._inflight_pulls, {},
+            "object pulls currently in progress")
+        rec("object.pull.inflight_bytes", "gauge", self._inflight_bytes, {},
+            "chunk bytes currently in flight across all pulls")
+        out.append(self._lat.record("object.pull.latency_s",
+                                    "whole-object pull latency (seconds)", now))
+        out.append(self._mbs.record("object.transfer.mb_s",
+                                    "per-pull transfer throughput (MB/s)", now))
+        return out
 
 
 def _as_actor(b):

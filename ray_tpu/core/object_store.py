@@ -20,6 +20,21 @@ from ray_tpu.core.native.build import build_lib
 _ID_SIZE = 20
 
 
+class _Pep688Probe:
+    def __buffer__(self, flags):
+        return memoryview(b"")
+
+
+try:  # PEP 688 (Python 3.12+): Python classes can export the buffer protocol
+    memoryview(_Pep688Probe()).release()
+    SUPPORTS_PEP688 = True
+except TypeError:
+    # Pre-3.12: memoryview() cannot see PinnedBuffer.__buffer__, so zero-copy
+    # pinned reads are impossible to do SAFELY (derived views would not hold
+    # the eviction pin). Readers degrade to a copy via PinnedBuffer.tobytes().
+    SUPPORTS_PEP688 = False
+
+
 class _Lib:
     _instance = None
     _lock = threading.Lock()
@@ -99,6 +114,12 @@ class PinnedBuffer:
     def __len__(self):
         return len(self._view)
 
+    def tobytes(self) -> bytes:
+        """Copy-out escape hatch for pre-PEP-688 interpreters (see
+        SUPPORTS_PEP688): the copy is safe without pin tracking because it
+        shares no pages with the arena."""
+        return bytes(self._view)
+
     def __del__(self):
         try:
             self._view.release()
@@ -153,6 +174,20 @@ class SharedMemoryClient:
     def seal(self, oid: ObjectID):
         if self._lib.store_seal(self._h, oid.binary()) != 0:
             raise KeyError(f"seal: {oid.hex()} not in created state")
+
+    def abort(self, oid: ObjectID) -> bool:
+        """Discard a created-but-unsealed entry. A plain delete() refuses it
+        (the writer pin from create() keeps refcount > 0), so a failed writer
+        would otherwise leak the allocation AND poison the oid on this node
+        forever — every later create raises ObjectExistsError. Seal first
+        (drops the writer pin), then delete. Only the writer may call this,
+        and only before the object's location is reported, so the transient
+        sealed state is unobservable."""
+        try:
+            self.seal(oid)
+        except KeyError:
+            pass  # already sealed (failure raced the seal) or never created
+        return self.delete(oid)
 
     def create_autoevict(self, oid: ObjectID, size: int) -> tuple[memoryview, list[ObjectID]]:
         """create(), spilling (if a spill dir exists) or evicting LRU objects
@@ -251,18 +286,6 @@ class SharedMemoryClient:
         try:
             with open(os.path.join(self.spill_dir, oid.hex()), "rb") as f:
                 return f.read()
-        except FileNotFoundError:
-            return None
-
-    def read_spilled_range(self, oid: ObjectID, offset: int, length: int) -> Optional[bytes]:
-        """Ranged disk read of a spilled payload (chunked remote pulls of a
-        spilled object must not re-read the whole file per chunk)."""
-        if not self.spill_dir:
-            return None
-        try:
-            with open(os.path.join(self.spill_dir, oid.hex()), "rb") as f:
-                f.seek(offset)
-                return f.read(length)
         except FileNotFoundError:
             return None
 

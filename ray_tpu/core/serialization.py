@@ -232,7 +232,18 @@ def serialize_args(args: tuple, kwargs: dict) -> tuple[bytes, list]:
 
 
 def deserialize(data: bytes | memoryview) -> Any:
-    data = memoryview(data)
+    try:
+        data = memoryview(data)
+    except TypeError:
+        # A PinnedBuffer on a pre-PEP-688 interpreter (Python < 3.12):
+        # memoryview() cannot see its __buffer__ export, so zero-copy
+        # deserialization is impossible to do safely (derived views would
+        # not hold the eviction pin). Degrade to a copy — correctness over
+        # zero-copy on old interpreters.
+        if hasattr(data, "tobytes"):
+            data = memoryview(data.tobytes())
+        else:
+            raise
     tag = bytes(data[:1])
     if tag == b"P":
         return pickle.loads(data[1:])

@@ -48,11 +48,12 @@ from ray_tpu.core.object_ref import (
     ObjectRefGenerator,
     set_ref_hooks,
 )
-from ray_tpu.core.object_store import MemoryStore, ObjectStoreFullError, SharedMemoryClient
+from ray_tpu.core.object_store import MemoryStore, ObjectExistsError, ObjectStoreFullError, SharedMemoryClient
 from ray_tpu.core.serialization import RemoteError
 from ray_tpu.core.task_spec import ActorSpec, TaskOptions, TaskSpec, scheduling_key
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
+from ray_tpu.util.bgtasks import spawn_bg as _spawn_bg_task
 
 logger = logging.getLogger(__name__)
 
@@ -149,11 +150,11 @@ class _KeySubmitter:
                     if retries == 0:
                         break
                 w.busy = True
-                asyncio.create_task(self._dispatch(w, items))
+                self.core._spawn_bg(self._dispatch(w, items))
         want = len(self.queue)
         while want > 0 and self.pending_lease_requests < min(want, self.core.config.max_pending_lease_requests_per_key):
             self.pending_lease_requests += 1
-            asyncio.create_task(self._request_lease())
+            self.core._spawn_bg(self._request_lease())
             want -= 1
 
     async def _request_lease(self):
@@ -317,6 +318,11 @@ class CoreWorker:
         self._actor_conns: dict[ActorID, dict] = {}  # actor_id -> {addr, conn, info}
         self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=1, thread_name_prefix="raytpu-exec")
         self._shutdown = False
+        # Strong refs to fire-and-forget tasks (asyncio tracks tasks only
+        # weakly; a gc cycle landing mid-await kills an unreferenced task
+        # with GeneratorExit — the init-task bug class). Everything spawned
+        # fire-and-forget on this worker's loop goes through _spawn_bg.
+        self._bg_tasks: set = set()
         # Submitted-task dependency pins: holding the ObjectRef objects keeps
         # their refcount registrations alive until the task completes
         # (reference: ReferenceCounter "submitted task references",
@@ -584,11 +590,18 @@ class CoreWorker:
             for t in asyncio.all_tasks():
                 if t is not asyncio.current_task():
                     t.cancel()
-            self.loop.stop()
 
+        # Stop the loop only AFTER _stop()'s result has been delivered back
+        # to this thread: loop.stop() inside the coroutine halts the loop
+        # before run_coroutine_threadsafe's done-callback can run, so
+        # .result() would always ride out its full timeout.
         try:
             asyncio.run_coroutine_threadsafe(_stop(), self.loop).result(timeout=5)
         except Exception:
+            pass
+        try:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+        except RuntimeError:
             pass
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=2)
@@ -635,6 +648,12 @@ class CoreWorker:
                     fn()
                 except Exception:  # isolate: one bad post must not drop the rest
                     logger.exception("posted submission callback failed")
+
+    def _spawn_bg(self, coro) -> "asyncio.Task":
+        """create_task with a strong reference held until completion (see
+        _bg_tasks: an unreferenced fire-and-forget task can be GC-killed
+        mid-await). Must be called from the IO loop."""
+        return _spawn_bg_task(self._bg_tasks, coro)
 
     def _run(self, coro, timeout=None):
         """Run a coroutine on the IO loop from a sync context."""
@@ -710,7 +729,7 @@ class CoreWorker:
             except Exception:
                 pass
 
-        asyncio.create_task(go())
+        self._spawn_bg(go())
 
     def _dec_local_ref(self, oid: ObjectID):
         rec = self.owned.get(oid)
@@ -738,7 +757,7 @@ class CoreWorker:
             self.owned.pop(oid, None)
             self.memory_store.delete(oid)
             if rec.in_shm:
-                asyncio.create_task(self._free_remote(oid))
+                self._spawn_bg(self._free_remote(oid))
             self._maybe_release_lineage(oid)
 
     def _maybe_release_lineage(self, oid: ObjectID):
@@ -818,7 +837,7 @@ class CoreWorker:
             rec.local_refs += 1
             self._mark_ready(oid, size=total, in_memory=not in_shm, in_shm=in_shm)
             if in_shm:
-                asyncio.ensure_future(self._report_shm_put(oid, total, evicted))
+                self._spawn_bg(self._report_shm_put(oid, total, evicted))
 
         self._post_to_loop(_commit)
         ref = ObjectRef(oid, self.address, total, _register=False)
@@ -972,7 +991,7 @@ class CoreWorker:
                     raise reply["error"]
                 if "inline" in reply:
                     return self._deserialize_value(reply["inline"])
-                if reply.get("in_shm") and await self._pull_to_local(oid):
+                if reply.get("in_shm") and await self._pull_to_local(oid, reply.get("locations")):
                     data = self._read_shm(oid)
                     if data is not None:
                         return self._deserialize_value(data)
@@ -1117,11 +1136,23 @@ class CoreWorker:
                 return self.store.read_spilled(oid)
         return buf
 
-    async def _pull_to_local(self, oid: ObjectID) -> bool:
+    async def _pull_to_local(self, oid: ObjectID, locations: list | None = None) -> bool:
         if self.daemon is None:
             return False
+        payload: dict = {"oid": oid.binary()}
+        if locations:
+            # Owner-supplied replica hints close the freshly-sealed race (the
+            # directory may not have absorbed report_object yet) and save a
+            # controller lookup.
+            payload["locations"] = locations
         try:
-            reply = await self.daemon.call("pull_object", {"oid": oid.binary()})
+            with _tracing.child_span("object.pull.wait", oid=oid.hex()[:16]):
+                # Capture the trace ctx INSIDE the wait span so the daemon's
+                # object.pull span nests under it rather than beside it.
+                tc = _tracing.current_trace()
+                if tc is not None:
+                    payload["tc"] = tc
+                reply = await self.daemon.call("pull_object", payload)
             return bool(reply.get("ok"))
         except Exception:
             return False
@@ -1140,7 +1171,7 @@ class CoreWorker:
         if rec is None:
             data = self.memory_store.get(oid)
             if data is not None:
-                return {"inline": bytes(data)}
+                return await self._inline_or_promote(oid, data)
             return None
         if rec.state == "PENDING":
             await rec.ready_event.wait()
@@ -1149,8 +1180,46 @@ class CoreWorker:
             return {"error": rec.error}
         data = self.memory_store.get(oid)
         if data is not None:
+            return await self._inline_or_promote(oid, data)
+        # locations: the freshly-sealed report_object may still be in flight
+        # to the directory; hand the borrower this node directly.
+        return {"in_shm": True, "locations": self._shm_locations()}
+
+    def _shm_locations(self) -> list:
+        return [{"node_id": self.node_id, "address": self.daemon_addr}] if self.daemon_addr else []
+
+    async def _inline_or_promote(self, oid: ObjectID, data) -> dict:
+        """Small memory-store objects ship inline in the reply; anything over
+        a chunk promotes to the shm arena so the borrower takes the streaming
+        pull path instead of receiving megabytes pickled inside one RPC."""
+        if self.store is None or self.daemon is None or len(data) <= self.config.object_chunk_size:
             return {"inline": bytes(data)}
-        return {"in_shm": True}
+        rec = self.owned.get(oid)
+        if rec is not None and rec.in_shm:
+            # Already promoted by an earlier borrower: don't re-put (raises
+            # ObjectExistsError) or re-announce the location per request.
+            return {"in_shm": True, "locations": self._shm_locations()}
+        if await self._promote_to_shm(oid, data):
+            return {"in_shm": True, "locations": self._shm_locations()}
+        return {"inline": bytes(data)}
+
+    async def _promote_to_shm(self, oid: ObjectID, data) -> bool:
+        announce = True
+        try:
+            evicted = self.store.put(oid, data)
+        except ObjectExistsError:
+            evicted = []  # already promoted (concurrent borrowers)
+            announce = False
+        except ObjectStoreFullError:
+            return False  # arena can't take it: fall back to inline
+        if evicted:
+            await self._report_evicted(evicted)
+        rec = self.owned.get(oid)
+        if rec is not None:
+            rec.in_shm = True
+        if announce and self.daemon is not None:
+            await self.daemon.notify("report_sealed", {"oid": oid.binary(), "size": len(data)})
+        return True
 
     async def handle_wait_owned(self, conn, p):
         oid = ObjectID(p["oid"])
@@ -1715,7 +1784,7 @@ class CoreWorker:
         q = self._actor_send_queues.get(spec.actor_id)
         if q is None:
             q = self._actor_send_queues[spec.actor_id] = asyncio.Queue()
-            asyncio.create_task(self._actor_send_pump(spec.actor_id, q))
+            self._spawn_bg(self._actor_send_pump(spec.actor_id, q))
         q.put_nowait((spec, dep_refs))
 
     async def _actor_send_pump(self, actor_id: ActorID, q: "asyncio.Queue"):

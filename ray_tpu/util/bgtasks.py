@@ -1,0 +1,21 @@
+"""Fire-and-forget asyncio tasks with strong references.
+
+asyncio tracks tasks only weakly: a gc cycle landing mid-await kills an
+unreferenced task with GeneratorExit (observed as lost sealed-object
+reports, never-reported worker deaths, and callers that wait out their
+full timeout). Every fire-and-forget create_task must keep the task
+referenced until it completes — this helper is the one place that
+pattern lives (Connection dispatch, NodeDaemon and CoreWorker both
+delegate here).
+"""
+import asyncio
+
+
+def spawn_bg(registry: set, coro, loop=None) -> "asyncio.Task":
+    """create_task with a strong reference held in ``registry`` until the
+    task completes. Pass ``loop`` when calling from a sync context that
+    holds a loop reference (no running loop to infer)."""
+    t = loop.create_task(coro) if loop is not None else asyncio.ensure_future(coro)
+    registry.add(t)
+    t.add_done_callback(registry.discard)
+    return t

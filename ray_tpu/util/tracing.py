@@ -143,6 +143,26 @@ def span(name: str, **attrs) -> Span:
     return Span(name, attrs or None)
 
 
+def event(name: str, **attrs):
+    """Record a point event (zero-duration span) onto the ACTIVE trace —
+    chunk retries, queue admissions, anything worth a timeline tick without
+    its own span. Free no-op when no trace is active."""
+    ctx = _ctx.get()
+    if ctx is None:
+        return
+    ev = {
+        "name": name,
+        "trace_id": ctx[0],
+        "span_id": new_span_id(),
+        "parent_id": ctx[1],
+        "ts": time.time(),
+        "dur": 0.0,
+    }
+    if attrs:
+        ev["attrs"] = attrs
+    _record_event(ev)
+
+
 def child_span(name: str, **attrs):
     """A span ONLY when a trace is already active, else a free no-op — the
     form internal subsystems (LLM engine, serve replica) use so untraced

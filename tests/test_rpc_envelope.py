@@ -1,8 +1,9 @@
-"""Coalesced-envelope wire protocol (rpc.py WIRE_VERSION 2): a frame's
-payload pickles to either ONE (kind, msg_id, method, payload) tuple or a
-LIST of them. N messages enqueued in one loop tick ship as one envelope —
-one length header, one version byte, one keyed-BLAKE2b tag, one pickle —
-and a lone frame is flushed the same tick (call_soon, never a timer)."""
+"""Coalesced-envelope wire protocol (rpc.py envelope lane; WIRE_VERSION 3
+since the raw chunk lane landed): a frame's payload pickles to either ONE
+(kind, msg_id, method, payload) tuple or a LIST of them. N messages enqueued
+in one loop tick ship as one envelope — one length header, one version byte,
+one keyed-BLAKE2b tag, one pickle — and a lone frame is flushed the same
+tick (call_soon, never a timer)."""
 import asyncio
 import pickle
 import time
