@@ -197,14 +197,40 @@ def bench_async_actor_async(n):
     report("1_1_async_actor_calls_async", n, timed(run, n))
 
 
-def bench_tasks_sync(n):
+# State-introspection A/B (detail.state_overhead): normal tasks exercise the
+# full lifecycle pipeline (submitted/dispatched/exec/finished events folded
+# into the controller's per-task index), so the tasks_sync row carries the
+# paired comparison. The OFF arm runs first in its OWN session with
+# task_events_enabled=False propagated cluster-wide (workers adopt the head
+# config), so executor-side emission is off too — not just the driver's.
+_STATE_AB: dict = {}
+
+
+def _tasks_sync_ops(n) -> float:
     rt.get(noop.remote(), timeout=60)
 
     def run(k):
         for _ in range(k):
             rt.get(noop.remote(), timeout=60)
 
-    report("single_client_tasks_sync", n, timed(run, n))
+    return n / timed(run, n)
+
+
+def bench_tasks_sync_state_off(n):
+    _STATE_AB["off_ops_s"] = _tasks_sync_ops(n)  # rides the ON row's detail
+
+
+def bench_tasks_sync(n):
+    ops = _tasks_sync_ops(n)
+    detail = None
+    off = _STATE_AB.get("off_ops_s")
+    if off:
+        detail = {"state_overhead": {
+            "on_ops_s": round(ops, 1),
+            "off_ops_s": round(off, 1),
+            "overhead_pct": round((off / ops - 1.0) * 100.0, 2),
+        }}
+    report("single_client_tasks_sync", n, n / ops, detail=detail)
 
 
 def bench_tasks_async(n):
@@ -353,6 +379,7 @@ def main():
         (bench_actor_nn_async, int(3000 * SCALE)),
         (bench_async_actor_sync, int(1000 * SCALE)),
         (bench_async_actor_async, int(3000 * SCALE)),
+        (bench_tasks_sync_state_off, int(500 * SCALE)),
         (bench_tasks_sync, int(500 * SCALE)),
         (bench_tasks_async, int(2000 * SCALE)),
         (bench_get_calls, int(3000 * SCALE)),
@@ -369,12 +396,18 @@ def main():
     # worker processes and measures scheduler thrash, not the runtime
     # (16 fake CPUs on this 1-core box: 591 tasks/s; 1 real CPU: 9099).
     ncpu = float(os.cpu_count() or 1)
+    from ray_tpu.core.config import get_config
+
     for fn, n in benches:
+        # The state A/B's OFF arm disables lifecycle events for its whole
+        # session (head config propagates to workers at registration).
+        get_config().task_events_enabled = fn is not bench_tasks_sync_state_off
         rt.init(num_cpus=ncpu, object_store_memory=512 * 1024 * 1024)
         try:
             fn(n)
         finally:
             rt.shutdown()
+            get_config().task_events_enabled = True
     with open("BENCH_CORE.json", "w") as f:
         json.dump(RESULTS, f, indent=1)
 

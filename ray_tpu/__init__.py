@@ -26,6 +26,7 @@ from ray_tpu.core.api import (
     timeline,
     wait,
 )
+from ray_tpu import state
 from ray_tpu.core.actor import method
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator, ObjectLostError, GetTimeoutError
 from ray_tpu.core.placement_group import PlacementGroup, placement_group, remove_placement_group
@@ -61,6 +62,7 @@ __all__ = [
     "remote",
     "remove_placement_group",
     "shutdown",
+    "state",
     "timeline",
     "wait",
 ]

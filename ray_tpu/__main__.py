@@ -9,84 +9,15 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 
 
 def _connect(address: str | None):
-    import ray_tpu as rt
     from ray_tpu import scripts
 
-    addr = address or os.environ.get("RAYTPU_ADDRESS") or scripts.head_address()
-    if not addr:
-        print("error: no --address, RAYTPU_ADDRESS unset, and no local head "
-              "(start one: python -m ray_tpu start --head)", file=sys.stderr)
-        sys.exit(2)
-    rt.init(address=addr)
-    return rt
-
-
-def _state(rt):
-    from ray_tpu.core import api
-
-    return api._cluster_state()
-
-
-def _rows(title, header, rows):
-    print(f"== {title} ==")
-    if not rows:
-        print("  (none)")
-        return
-    widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
-    for r in [header] + rows:
-        print("  " + "  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
-
-
-def cmd_status(args):
-    rt = _connect(args.address)
-    s = _state(rt)
-    nodes = s["nodes"]
-    alive = [n for n in nodes.values() if n["state"] == "ALIVE"]
-    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
-    total: dict = {}
-    avail: dict = {}
-    for n in alive:
-        for k, v in n["resources_total"].items():
-            total[k] = total.get(k, 0) + v
-        for k, v in n["resources_available"].items():
-            avail[k] = avail.get(k, 0) + v
-    for k in sorted(total):
-        print(f"  {k}: {total[k] - avail.get(k, 0):g}/{total[k]:g} used")
-    print(f"actors: {sum(1 for a in s['actors'].values() if a['state'] == 'ALIVE')} alive")
-    print(f"placement groups: {len(s['placement_groups'])}")
-    print(f"objects tracked: {s['objects']['count']} ({s['objects']['bytes'] / 1e6:.1f} MB)")
-
-
-def cmd_list(args):
-    rt = _connect(args.address)
-    s = _state(rt)
-    kind = args.kind
-    if kind == "nodes":
-        _rows("nodes", ["node_id", "state", "address", "resources"], [
-            [nid[:12], n["state"], n["address"], json.dumps(n["resources_total"])]
-            for nid, n in s["nodes"].items()
-        ])
-    elif kind == "actors":
-        _rows("actors", ["actor_id", "state", "name", "node", "restarts"], [
-            [aid[:12], a["state"], a["name"] or "-", (a["node_id"] or "-")[:12], a["restarts"]]
-            for aid, a in s["actors"].items()
-        ])
-    elif kind == "pgs":
-        _rows("placement groups", ["pg_id", "state", "strategy", "bundles"], [
-            [pid[:12], g["state"], g["strategy"], len(g["bundles"])]
-            for pid, g in s["placement_groups"].items()
-        ])
-    elif kind == "jobs":
-        from ray_tpu.job import JobSubmissionClient
-
-        _rows("jobs", ["job_id", "status", "entrypoint"], [
-            [j["job_id"], j["status"], j["entrypoint"][:48]] for j in JobSubmissionClient().list_jobs()
-        ])
+    # One connect helper for every CLI subcommand (discovery chain:
+    # --address -> RAYTPU_ADDRESS -> live local head).
+    return scripts._connect_driver(address)
 
 
 def cmd_events(args):
@@ -194,9 +125,7 @@ def main(argv=None):
     sub = p.add_subparsers(dest="cmd", required=True)
     scripts.add_start_parser(sub)
     scripts.add_stop_parser(sub)
-    sub.add_parser("status")
-    lp = sub.add_parser("list")
-    lp.add_argument("kind", choices=["nodes", "actors", "pgs", "jobs"])
+    scripts.add_state_parsers(sub)  # list | summary | memory | status | logs
     ep = sub.add_parser("events")
     ep.add_argument("--limit", type=int, default=100)
     sub.add_parser("metrics")
@@ -226,8 +155,11 @@ def main(argv=None):
     if args.cmd == "stop":
         sys.exit(scripts.cmd_stop(args))
     {
-        "status": cmd_status,
-        "list": cmd_list,
+        "status": scripts.cmd_status,
+        "list": scripts.cmd_list,
+        "summary": scripts.cmd_summary,
+        "memory": scripts.cmd_memory,
+        "logs": scripts.cmd_logs,
         "events": cmd_events,
         "metrics": cmd_metrics,
         "job": cmd_job,

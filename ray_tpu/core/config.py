@@ -102,6 +102,17 @@ class Config:
     log_dir: str = ""
     metrics_report_interval_s: float = 5.0
     event_buffer_size: int = 10000
+    # --- state introspection (task lifecycle FSM -> controller index) ---
+    # Emit per-attempt task lifecycle events (worker.py _task_event). Off,
+    # the state API sees no tasks (tracing still works); the flag exists so
+    # the pipeline's cost can be A/B'd (bench_core detail.state_overhead).
+    task_events_enabled: bool = True
+    # Debounce window for the early lifecycle-event flush: a transition
+    # reaches the controller within this bound instead of the metrics tick.
+    task_event_flush_interval_s: float = 0.5
+    # Per-task state index bound on the controller ((task_id, attempt)
+    # records); overflow evicts terminal-first and counts tasks_evicted.
+    task_index_size: int = 8192
     # --- security ---
     # OPT-IN per-session shared secret for the RPC layer (pickle-over-TCP
     # executes code on unpickle; with a token set, every frame carries an

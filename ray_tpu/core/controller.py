@@ -25,8 +25,10 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ray_tpu.core import rpc
+from ray_tpu.core import task_state as _ts
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.util import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -45,6 +47,10 @@ class NodeRecord:
     conn: Any = None
     last_heartbeat: float = 0.0
     state: str = "ALIVE"
+    # Latest daemon-reported object-store occupancy + worker table (rides
+    # every heartbeat; the state API's list_nodes/list_workers source).
+    store_stats: dict = field(default_factory=dict)
+    workers: list = field(default_factory=list)
     # Drain protocol (reference: NodeManagerService.DrainRaylet): a draining
     # node accepts no NEW leases/actors/bundles but keeps serving running
     # work and object reads until the drainer terminates it.
@@ -171,6 +177,12 @@ class Controller:
         self.traces_evicted = 0  # whole traces dropped by the index bound
         self.MAX_TRACES = 256
         self.MAX_TRACE_EVENTS = 512
+        # Per-task state index (GcsTaskManager equivalent): one record per
+        # (task_id, attempt), folded from lifecycle events (task_state.py).
+        # Bounded independently of the flat task_events buffer — trimming
+        # that buffer no longer loses live-task state.
+        self.task_index: dict[tuple[str, int], dict] = {}
+        self.tasks_evicted = 0  # index records dropped by the bound
         self._dirty = False
         # Actors restored from a snapshot as ALIVE/RESTARTING must be
         # re-confirmed by their daemon's re-registration within the grace
@@ -205,7 +217,9 @@ class Controller:
         await self.server.close()
 
     def _event(self, kind: str, **kw):
-        self.events.append({"ts": time.time(), "kind": kind, **kw})
+        # tracing.now(): one clock across controller events, worker task
+        # events, and spans (comparable timestamps in merged views).
+        self.events.append({"ts": _tracing.now(), "kind": kind, **kw})
         self._dirty = True
         if len(self.events) > self.config.event_buffer_size:
             trimmed = len(self.events) // 2
@@ -473,6 +487,13 @@ class Controller:
         node = self.nodes.get(p["node_id"])
         if node:
             node.last_heartbeat = time.monotonic()
+            # Piggybacked node state (object-store occupancy + worker
+            # table): the list_nodes/list_workers source, refreshed every
+            # heartbeat without extra RPCs.
+            if "store" in p:
+                node.store_stats = p["store"]
+            if "workers" in p:
+                node.workers = p["workers"]
         return True
 
     def handle_get_cluster_state(self, conn, p):
@@ -519,6 +540,7 @@ class Controller:
                 "task_events": self.task_events_dropped,
                 "worker_events": worker_dropped,
                 "traces_evicted": self.traces_evicted,
+                "tasks_evicted": self.tasks_evicted,
             },
         }
 
@@ -562,11 +584,44 @@ class Controller:
             tid = ev.get("trace_id")
             if tid:
                 self._index_trace_event(tid, ev)
+            if ev.get("kind") in _ts.EVENT_STATE:
+                self._fold_task_event(ev)
         if len(self.task_events) > 4 * self.config.event_buffer_size:
             trimmed = len(self.task_events) // 2
             self.task_events_dropped += trimmed
             del self.task_events[:trimmed]
         return True
+
+    def _fold_task_event(self, ev: dict):
+        """Fold one lifecycle event into the bounded per-(task_id, attempt)
+        index (reference: GcsTaskManager's per-task storage with its own
+        bound + eviction counter, independent of the raw event buffer)."""
+        task_id = ev.get("task_id")
+        if not task_id:
+            return
+        key = (task_id, int(ev.get("attempt", 0)))
+        record = self.task_index.get(key)
+        if record is None:
+            while len(self.task_index) >= max(16, self.config.task_index_size):
+                self._evict_task_record()
+            record = self.task_index[key] = {"task_id": task_id, "attempt": key[1]}
+        _ts.fold(record, ev)
+
+    def _evict_task_record(self):
+        """Evict one index record: the oldest TERMINAL record within a
+        bounded scan window, else the oldest outright — live tasks survive
+        overflow as long as finished ones are available to shed."""
+        victim = None
+        for i, (key, record) in enumerate(self.task_index.items()):
+            if record.get("state") in _ts.TERMINAL:
+                victim = key
+                break
+            if i >= 64:  # bounded scan; an all-live prefix evicts the oldest
+                break
+        if victim is None:
+            victim = next(iter(self.task_index))
+        del self.task_index[victim]
+        self.tasks_evicted += 1
 
     def _index_trace_event(self, trace_id: str, ev: dict):
         t = self.traces.get(trace_id)
@@ -598,7 +653,30 @@ class Controller:
 
     def handle_get_task_events(self, conn, p):
         limit = int(p.get("limit", 20000))
-        return self.task_events[-limit:] if limit > 0 else []
+        if "since" not in p:
+            return self.task_events[-limit:] if limit > 0 else []
+        # Cursor mode for pollers (dashboard, CLI --follow): `since` is an
+        # ABSOLUTE event sequence number (monotone across buffer trims —
+        # task_events_dropped counts exactly the events trimmed off the
+        # front), so each poll copies only what's new instead of the whole
+        # 20k-event tail. The reply's `next` feeds the next poll; `missed`
+        # counts events trimmed away before the poller got to them.
+        base = self.task_events_dropped
+        since = int(p["since"])
+        # Clamp into the live window BOTH ways: a cursor from before a trim
+        # skips forward (missed counts the loss); a cursor from a previous
+        # controller incarnation (restart reset base+buffer) lands past the
+        # end — rewind to the current end and return a smaller `next`, so
+        # the poller self-heals instead of freezing on an empty reply
+        # forever.
+        start = max(0, min(since - base, len(self.task_events)))
+        events = self.task_events[start : start + limit] if limit > 0 else []
+        return {
+            "events": events,
+            "next": base + start + len(events),
+            "missed": max(0, base - since),
+            "truncated": start + len(events) < len(self.task_events),
+        }
 
     def handle_get_trace(self, conn, p):
         """Every indexed event of one trace, time-ordered."""
@@ -630,6 +708,181 @@ class Controller:
             if len(out) >= limit:
                 break
         return out
+
+    # -- state API (ray list/summary/memory equivalent) ------------------
+    # Server-side filtering + explicit truncation markers on every list
+    # endpoint (reference: python/ray/util/state — the StateApiClient always
+    # reports total vs returned so "I saw everything" is never assumed).
+
+    @staticmethod
+    def _truncate(matched: list, limit: int) -> dict:
+        return {
+            "total": len(matched),
+            "truncated": max(0, len(matched) - limit),
+            "items": matched[:limit],
+        }
+
+    def handle_list_tasks(self, conn, p):
+        state = p.get("state")
+        node = p.get("node")
+        fn = p.get("fn")
+        job = p.get("job")
+        task_id = p.get("task_id")
+        limit = int(p.get("limit", 100))
+        matched = []
+        # Newest first: dict preserves insertion order; reversed => recent.
+        for record in reversed(list(self.task_index.values())):
+            if state and record.get("state") != state:
+                continue
+            if node and not (record.get("node_id") or "").startswith(node):
+                continue
+            if fn and fn not in (record.get("fn") or ""):
+                continue
+            if job and not (record.get("job_id") or "").startswith(job):
+                continue
+            if task_id and not record["task_id"].startswith(task_id):
+                continue
+            matched.append(record)
+        out = self._truncate(matched, limit)
+        out["tasks"] = out.pop("items")
+        out["evicted"] = self.tasks_evicted
+        return out
+
+    def handle_summary_tasks(self, conn, p):
+        """Per-function rollup of the task index (reference: `ray summary
+        tasks` — GcsTaskManager's TaskSummaries by func_or_class_name)."""
+        job = p.get("job")
+        by_fn: dict[str, dict] = {}
+        for record in self.task_index.values():
+            if job and not (record.get("job_id") or "").startswith(job):
+                continue
+            fn = record.get("fn") or "?"
+            ent = by_fn.setdefault(fn, {"total": 0, "states": {}})
+            ent["total"] += 1
+            st = record.get("state") or "?"
+            ent["states"][st] = ent["states"].get(st, 0) + 1
+        return {
+            "summary": by_fn,
+            "total_tasks": len(self.task_index),
+            "evicted": self.tasks_evicted,
+        }
+
+    def handle_get_task(self, conn, p):
+        """Every indexed attempt of one task id (prefix match), oldest first."""
+        tid = p["task_id"]
+        return sorted(
+            (r for (t, _a), r in self.task_index.items() if t.startswith(tid)),
+            key=lambda r: (r["task_id"], r["attempt"]),
+        )
+
+    def handle_list_actors(self, conn, p):
+        state = p.get("state")
+        node = p.get("node")
+        name = p.get("name")
+        job = p.get("job")
+        limit = int(p.get("limit", 100))
+        matched = []
+        for a in reversed(list(self.actors.values())):
+            if state and a.state != state:
+                continue
+            if node and not a.node_id.startswith(node):
+                continue
+            if name and name not in a.spec.name and name not in a.spec.cls_id:
+                continue
+            if job and not a.spec.job_id.hex().startswith(job):
+                continue
+            matched.append({
+                "actor_id": a.actor_id.hex(),
+                "state": a.state,
+                "name": a.spec.name,
+                "class": a.spec.cls_id,
+                "node_id": a.node_id,
+                "worker_id": a.worker_id,
+                "worker_addr": a.worker_addr,
+                "job_id": a.spec.job_id.hex(),
+                "restarts": a.restarts_used,
+                "death_cause": a.death_cause,
+            })
+        out = self._truncate(matched, limit)
+        out["actors"] = out.pop("items")
+        return out
+
+    def handle_list_objects(self, conn, p):
+        node = p.get("node")
+        limit = int(p.get("limit", 100))
+        matched = []
+        for oid, node_ids in self.object_dir.items():
+            if node and not any(n.startswith(node) for n in node_ids):
+                continue
+            matched.append({
+                "oid": oid.hex() if hasattr(oid, "hex") else str(oid),
+                "size": self.object_sizes.get(oid, 0),
+                "locations": sorted(node_ids),
+            })
+        matched.sort(key=lambda o: -o["size"])
+        out = self._truncate(matched, limit)
+        out["objects"] = out.pop("items")
+        out["total_bytes"] = sum(self.object_sizes.values())
+        return out
+
+    def handle_list_nodes(self, conn, p):
+        state = p.get("state")
+        now = time.monotonic()
+        matched = [
+            {
+                "node_id": nid,
+                "state": n.state,
+                "draining": n.draining,
+                "address": n.address,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+                "labels": n.labels,
+                "store": n.store_stats,
+                "workers": len(n.workers),
+                "heartbeat_age_s": round(now - n.last_heartbeat, 3) if n.last_heartbeat else None,
+            }
+            for nid, n in self.nodes.items()
+            if not state or n.state == state
+        ]
+        out = self._truncate(matched, int(p.get("limit", 1000)))
+        out["nodes"] = out.pop("items")
+        return out
+
+    def handle_list_workers(self, conn, p):
+        state = p.get("state")
+        node = p.get("node")
+        limit = int(p.get("limit", 1000))
+        matched = []
+        for nid, n in self.nodes.items():
+            if n.state != "ALIVE" or (node and not nid.startswith(node)):
+                continue
+            for w in n.workers:
+                if state and w.get("state") != state:
+                    continue
+                matched.append({"node_id": nid, **w})
+        out = self._truncate(matched, limit)
+        out["workers"] = out.pop("items")
+        return out
+
+    async def handle_memory_summary(self, conn, p):
+        """Cluster-wide `ray memory` equivalent: fan out to every live
+        daemon (which fans out to ITS workers) and return the per-worker
+        ownership/reference tables plus per-node store occupancy."""
+        limit = int(p.get("limit", 200))
+
+        async def one(node: NodeRecord):
+            try:
+                return await asyncio.wait_for(
+                    node.conn.call("memory_summary", {"limit": limit}), timeout=15
+                )
+            except Exception as e:
+                return {"node_id": node.node_id, "error": f"{type(e).__name__}: {e}"}
+
+        live = [
+            n for n in self.nodes.values()
+            if n.state == "ALIVE" and n.conn is not None and not n.conn.closed
+        ]
+        return {"nodes": list(await asyncio.gather(*(one(n) for n in live)))}
 
     # -- metrics aggregation (ray.util.metrics equivalent pipeline) ------
     def handle_report_metrics(self, conn, p):
@@ -690,7 +943,13 @@ class Controller:
                 {"what": "leases"}, "lease requests waiting for capacity"),
             rec("scheduler.pending", "gauge", len(self.pending_actors),
                 {"what": "actors"}, "actors parked until placeable"),
+            rec("state.task_index.size", "gauge", len(self.task_index),
+                {}, "per-task state index records currently held"),
         ]
+        if self.tasks_evicted:
+            out.append(rec("state.task_index.evicted_total", "counter",
+                           self.tasks_evicted, {},
+                           "task state records dropped by the index bound"))
         if self.events_dropped:
             out.append(rec("events_dropped_total", "counter", self.events_dropped,
                            {"where": "controller"}, "control events lost to log trims"))

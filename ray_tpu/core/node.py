@@ -230,7 +230,22 @@ class NodeDaemon:
         while True:
             await asyncio.sleep(self.config.heartbeat_interval_s)
             try:
-                await self.controller.notify("heartbeat", {"node_id": self.node_id})
+                await self.controller.notify("heartbeat", {
+                    "node_id": self.node_id,
+                    # Piggybacked node state for the controller's
+                    # list_nodes/list_workers views (object-store occupancy
+                    # + worker table; a few hundred bytes per beat).
+                    "store": self._store_stats(),
+                    "workers": [
+                        {
+                            "worker_id": w.worker_id,
+                            "state": w.state,
+                            "address": w.address,
+                            "actors": len(w.actor_ids),
+                        }
+                        for w in self.workers.values()
+                    ],
+                })
             except Exception:
                 pass
 
@@ -651,8 +666,68 @@ class NodeDaemon:
         except Exception:
             pass
 
-    def handle_store_stats(self, conn, p):
+    def _store_stats(self) -> dict:
+        """The one shape of this node's arena occupancy (heartbeat piggyback,
+        store_stats RPC, memory_summary) — add a stat here, not per caller."""
         return {"capacity": self.store.capacity, "used": self.store.used, "num_objects": self.store.num_objects}
+
+    def handle_store_stats(self, conn, p):
+        return self._store_stats()
+
+    async def handle_memory_summary(self, conn, p):
+        """Per-node half of the cluster `ray memory` fan-out: this node's
+        store occupancy plus every live resident worker's ownership/
+        reference summary (workers answer the same RPC in-process)."""
+        limit = int(p.get("limit", 200))
+
+        async def one(w: WorkerRecord):
+            try:
+                return await asyncio.wait_for(
+                    w.conn.call("memory_summary", {"limit": limit}), timeout=10
+                )
+            except Exception as e:
+                return {"worker_id": w.worker_id, "error": f"{type(e).__name__}: {e}"}
+
+        live = [
+            w for w in self.workers.values()
+            if w.state not in ("DEAD", "STARTING") and w.conn is not None and not w.conn.closed
+        ]
+        return {
+            "node_id": self.node_id,
+            "store": self._store_stats(),
+            "workers": list(await asyncio.gather(*(one(w) for w in live))),
+        }
+
+    def handle_tail_worker_log(self, conn, p):
+        """Serve the tail of a resident worker's log file (the fetch half of
+        `raytpu logs`; the follow half rides the controller's `logs` pubsub).
+        Accepts a worker-id prefix; returns both streams' tails."""
+        prefix = p.get("worker_id", "")
+        max_bytes = min(int(p.get("max_bytes", 64 * 1024)), 1024 * 1024)
+        out = {}
+        if not os.path.isdir(self.log_dir):
+            return out
+        for name in sorted(os.listdir(self.log_dir)):
+            if not name.startswith("worker-"):
+                continue
+            stem, _, ext = name.rpartition(".")
+            wid = stem[len("worker-"):]
+            if ext not in ("out", "err") or not wid.startswith(prefix):
+                continue
+            path = os.path.join(self.log_dir, name)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - max_bytes))
+                    data = f.read(max_bytes)
+            except OSError:
+                continue
+            lines = data.decode("utf-8", errors="replace").splitlines()
+            if size > max_bytes and lines:
+                lines = lines[1:]  # drop the partial first line of the window
+            out.setdefault(wid, {})["stderr" if ext == "err" else "stdout"] = lines
+        return out
 
 
 class _LocalHist:

@@ -31,6 +31,11 @@ class RemoteFunction:
         if self._fn_id is None or getattr(self, "_fn_session", None) is not core:
             self._fn_id = core.export_callable("fn", self._fn)
             self._fn_session = core
+            if not self._opts.name:
+                # Human-readable name for the state index / `raytpu list
+                # tasks` (the export key is a content hash). Set once on the
+                # shared options object so its interned identity is stable.
+                self._opts.name = getattr(self._fn, "__name__", "") or ""
         # Reuse the handle's options object (submit treats it as immutable):
         # a stable identity lets the wire layer intern it per connection and
         # ship lean per-call frames. Runtime-env packaging is cached on the

@@ -50,6 +50,7 @@ from ray_tpu.core.object_ref import (
 )
 from ray_tpu.core.object_store import MemoryStore, ObjectExistsError, ObjectStoreFullError, SharedMemoryClient
 from ray_tpu.core.serialization import RemoteError
+from ray_tpu.core import task_state as _ts
 from ray_tpu.core.task_spec import ActorSpec, TaskOptions, TaskSpec, scheduling_key
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
@@ -71,6 +72,19 @@ _task_latency_actor = _task_latency.bind({"kind": "actor"})
 
 
 _MISS = object()  # sentinel: value not locally resident
+
+
+def _spec_fn_name(spec: "TaskSpec") -> str:
+    """Human-readable callable name for state-index/event attribution:
+    the explicit options name, the actor method, else the export key."""
+    return spec.options.name or spec.method_name or spec.fn_id[:24]
+
+
+def _error_type(err: BaseException) -> str:
+    """The FAILED{error_type} discriminator: the USER exception's type when
+    a RemoteError wraps one, else the infrastructure error's own type."""
+    cause = getattr(err, "cause", None)
+    return type(cause).__name__ if cause is not None else type(err).__name__
 
 
 class ActorDiedError(Exception):
@@ -104,6 +118,7 @@ class LeasedWorker:
     worker_id: str
     node_addr: str
     lease_id: str
+    node_id: str = ""  # controller node id (state-index attribution)
     conn: Any = None
     busy: bool = False
     last_used: float = 0.0
@@ -183,7 +198,8 @@ class _KeySubmitter:
                     "lease_worker",
                     {"lease_id": lease_id, "runtime_env": self.opts.runtime_env or None},
                 )
-                w = LeasedWorker(lease["address"], lease["worker_id"], reply["address"], lease_id)
+                w = LeasedWorker(lease["address"], lease["worker_id"], reply["address"], lease_id,
+                                 node_id=reply.get("node_id", ""))
                 w.conn = await self.core._peer_conn(w.address)
             except Exception:
                 # The controller already consumed resources for this lease;
@@ -243,10 +259,16 @@ class _KeySubmitter:
                 else:
                     msg = {"lean": (
                         spec.task_id.binary(), spec.args_blob, spec.num_returns, ent[1],
+                        getattr(spec, "_attempts", 0),
                     )}
                     if spec.trace_ctx is not None:
                         msg["tc"] = spec.trace_ctx
                     wire.append(msg)
+            for spec, _ in items:
+                # FSM: the attempt left the submitter queue for a concrete
+                # worker — node/worker attribution is known from here on.
+                self.core._task_event("task_dispatched", spec,
+                                      node=w.node_id, exec_worker=w.worker_id[:12])
             reply = await w.conn.call("push_tasks", {"specs": wire})
             for (spec, fut), r in zip(items, reply["results"]):
                 self.core._absorb_task_reply(spec, r, fut)
@@ -258,6 +280,12 @@ class _KeySubmitter:
                     retries = self.core.config.max_task_retries_default
                 attempts = getattr(spec, "_attempts", 0)
                 if attempts < retries:
+                    # Close the superseded attempt's index record: without a
+                    # terminal event it would sit SUBMITTED/RUNNING forever,
+                    # and the terminal-first eviction policy would shed real
+                    # live state around these immortal ghosts.
+                    self.core._task_event("task_failed", spec, attempt=attempts,
+                                          error_type=type(e).__name__, retrying=True)
                     spec._attempts = attempts + 1  # type: ignore[attr-defined]
                     logger.warning("task %s lost worker (%s); retry %d", spec.task_id.hex()[:8], e, attempts + 1)
                     self.queue.append((spec, fut))
@@ -364,6 +392,11 @@ class CoreWorker:
         self._events_reported = 0  # high-water mark shipped to the controller
         self._events_dropped = 0  # events discarded by buffer trims (observable loss)
         self._events_flush_lock = asyncio.Lock()
+        self._event_flush_armed = False  # debounced lifecycle-event flush timer
+        # Borrowed-object table: oid bytes -> {"owner_addr", "refs"} — the
+        # borrower half of the ownership picture memory_summary reports
+        # (the owner half is `owned` with its borrowers counter).
+        self._borrowed: dict[bytes, dict] = {}
         # Object-store access counters (plain ints: no lock on the get/put
         # hot paths; shipped as counter series by the metrics reporter).
         self._obj_hits = 0
@@ -687,7 +720,9 @@ class CoreWorker:
         return conn
 
     def _event(self, kind: str, **kw):
-        self.task_events.append({"ts": time.time(), "kind": kind, "worker": self.worker_id[:12], **kw})
+        # One timeline: the same clock as Span/event() in util/tracing, so
+        # state-index timings and span timings interleave consistently.
+        self.task_events.append({"ts": _tracing.now(), "kind": kind, "worker": self.worker_id[:12], **kw})
         if len(self.task_events) > self.config.event_buffer_size:
             trimmed = len(self.task_events) // 2
             # Only events the controller never saw are LOST; already-reported
@@ -695,6 +730,46 @@ class CoreWorker:
             self._events_dropped += max(0, trimmed - self._events_reported)
             del self.task_events[:trimmed]
             self._events_reported = max(0, self._events_reported - trimmed)
+
+    def _task_event(self, kind: str, spec: TaskSpec, **kw):
+        """Emit one task-lifecycle FSM event (task_state.EVENT_STATE keys
+        it to a transition) carrying the attempt number and attribution the
+        controller's per-task index folds. Gated by task_events_enabled so
+        the state pipeline can be A/B'd off; always called on the IO loop."""
+        if not self.config.task_events_enabled and spec.trace_ctx is None:
+            return  # traced events still flow: tracing must survive the A/B flag
+        fields = {
+            "task_id": spec.task_id.hex(),
+            "attempt": getattr(spec, "_attempts", 0),
+            "fn": _spec_fn_name(spec),
+            "job": spec.job_id.hex(),
+        }
+        tc = spec.trace_ctx
+        if tc is not None:
+            fields["trace_id"], fields["parent_id"] = tc[0], tc[1]
+        fields.update(kw)
+        self._event(kind, **fields)
+        self._arm_event_flush()
+
+    def _arm_event_flush(self):
+        """Debounced early flush: lifecycle transitions reach the controller
+        within task_event_flush_interval_s instead of riding the (much
+        slower) metrics tick, so `raytpu list tasks --state RUNNING` sees a
+        task soon after it starts. One timer per window, not per event."""
+        if self._event_flush_armed or self._shutdown:
+            return
+        self._event_flush_armed = True
+        try:
+            self.loop.call_later(
+                self.config.task_event_flush_interval_s, self._event_flush_fire
+            )
+        except Exception:
+            self._event_flush_armed = False
+
+    def _event_flush_fire(self):
+        self._event_flush_armed = False
+        if not self._shutdown:
+            self._spawn_bg(self._flush_task_events())
 
     # -- ownership / refcounting ---------------------------------------
     def _on_ref_created(self, ref: ObjectRef):
@@ -722,6 +797,21 @@ class CoreWorker:
             pass
 
     def _notify_owner(self, owner_addr: str, method: str, oid_bin: bytes):
+        # Borrower-side ledger (runs on the IO loop, FIFO with the notify):
+        # memory_summary reports who this process borrows from, mirroring
+        # the owner's borrowers counter.
+        if method == "add_borrow":
+            ent = self._borrowed.get(oid_bin)
+            if ent is None:
+                ent = self._borrowed[oid_bin] = {"owner_addr": owner_addr, "refs": 0}
+            ent["refs"] += 1
+        elif method == "remove_borrow":
+            ent = self._borrowed.get(oid_bin)
+            if ent is not None:
+                ent["refs"] -= 1
+                if ent["refs"] <= 0:
+                    del self._borrowed[oid_bin]
+
         async def go():
             try:
                 conn = await self._peer_conn(owner_addr)
@@ -790,6 +880,9 @@ class CoreWorker:
 
     def _fail_task_returns(self, spec: TaskSpec, err: BaseException):
         self._inflight_deps.pop(spec.task_id.binary(), None)
+        # Terminal failure without a reply (infeasible demand, retries
+        # exhausted, actor death, dep-resolution failure).
+        self._task_event("task_failed", spec, error_type=_error_type(err))
         if spec.num_returns == -1:
             gen = self._streaming.pop(spec.task_id.binary(), None)
             if gen is not None:
@@ -1356,6 +1449,9 @@ class CoreWorker:
                 self._streaming[task_id.binary()] = gen
             self._register_returns(return_refs)
             if dep_refs:
+                # FSM: the attempt exists but its args aren't resolved yet;
+                # _enqueue_submit advances it to PENDING_NODE_ASSIGNMENT.
+                self._task_event("task_pending_args", spec)
                 asyncio.ensure_future(self._submit(spec, dep_refs))
             else:
                 self._enqueue_submit(spec)
@@ -1389,10 +1485,10 @@ class CoreWorker:
         sub.queue.append((spec, fut))
         tc = spec.trace_ctx
         if tc is None:
-            self._event("task_submitted", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
+            self._task_event("task_submitted", spec)
         else:
-            self._event("task_submitted", task_id=spec.task_id.hex(), fn=spec.fn_id[:24],
-                        trace_id=tc[0], span_id=tc[1])
+            # span_id rides along for export_timeline's flow arrows.
+            self._task_event("task_submitted", spec, span_id=tc[1])
         sub.pump()
 
     async def _wait_deps(self, dep_refs: list[ObjectRef]):
@@ -1423,7 +1519,16 @@ class CoreWorker:
     def _absorb_task_reply(self, spec: TaskSpec, reply: dict, fut: asyncio.Future | None = None):
         """Record task return values from a push_task reply."""
         deps = self._inflight_deps.pop(spec.task_id.binary(), None)
-        self._event("task_finished", task_id=spec.task_id.hex(), status=reply.get("status"))
+        # Untraced actor SUCCESSES stay event-free: the actor call path is
+        # the RPC hot row, and one task_finished per ping would both cost
+        # per-call CPU and flood the controller's task index with
+        # FINISHED-only records that evict real task state. Failures and
+        # traced calls always report.
+        if spec.actor_id is None or spec.trace_ctx is not None or reply.get("status") == "error":
+            extra = {}
+            if reply.get("status") == "error" and reply.get("error") is not None:
+                extra["error_type"] = _error_type(reply["error"])
+            self._task_event("task_finished", spec, status=reply.get("status"), **extra)
         if spec.num_returns == -1:  # streaming: items arrived via notifies
             self._stream_conns.pop(spec.task_id.binary(), None)
             gen = self._streaming.pop(spec.task_id.binary(), None)
@@ -1505,13 +1610,16 @@ class CoreWorker:
                     spec.options, spec.job_id, spec.caller_addr, spec.fn_id
                 )
             return spec
-        tid, args_blob, num_returns, oid = p["lean"]
+        tid, args_blob, num_returns, oid, attempt = p["lean"]
         options, job_id, caller_addr, fn_id = conn.meta["opts_in"][oid]
-        return TaskSpec(
+        spec = TaskSpec(
             task_id=TaskID(tid), job_id=job_id, fn_id=fn_id, args_blob=args_blob,
             num_returns=num_returns, options=options, caller_addr=caller_addr,
             trace_ctx=p.get("tc"),
         )
+        if attempt:
+            spec._attempts = attempt  # type: ignore[attr-defined] - retried attempt: exec events key the same index record
+        return spec
 
     async def handle_push_task(self, conn, p):
         """Execute a pushed task (reference: CoreWorkerService.PushTask ->
@@ -1525,13 +1633,13 @@ class CoreWorker:
             loop = asyncio.get_running_loop()
             tc = spec.trace_ctx
             if tc is None:
-                self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24])
+                self._task_event("task_exec_start", spec, node=self.node_id)
             else:
                 # The execution span: child of the submitter's span; user code
                 # inside the task sees (trace_id, exec_span) as its context.
                 spec._exec_ctx = (tc[0], _tracing.new_span_id())  # type: ignore[attr-defined]
-                self._event("task_exec_start", task_id=spec.task_id.hex(), fn=spec.fn_id[:24],
-                            trace_id=tc[0], span_id=spec._exec_ctx[1], parent_id=tc[1])
+                self._task_event("task_exec_start", spec, node=self.node_id,
+                                 span_id=spec._exec_ctx[1])
             t0 = time.monotonic()
             try:
                 if streaming:
@@ -1545,12 +1653,12 @@ class CoreWorker:
             finally:
                 _task_latency_task.observe(time.monotonic() - t0)
                 if tc is None:
-                    self._event("task_exec_end", task_id=spec.task_id.hex())
+                    self._task_event("task_exec_end", spec, node=self.node_id)
                 else:
                     # Carry the trace id so the controller's trace index sees
                     # the execution END too (duration, not just the start).
-                    self._event("task_exec_end", task_id=spec.task_id.hex(),
-                                trace_id=tc[0], span_id=spec._exec_ctx[1])
+                    self._task_event("task_exec_end", spec, node=self.node_id,
+                                     span_id=spec._exec_ctx[1])
         finally:
             if streaming:
                 self._stream_cleanup(spec.task_id.binary())
@@ -1767,8 +1875,7 @@ class CoreWorker:
                 # Submission event ONLY when traced: actor calls are the hot
                 # path and normally emit no events at all (export_timeline's
                 # flow arrows need the submit side of the hop).
-                self._event("task_submitted", task_id=spec.task_id.hex(),
-                            fn=method[:24], trace_id=tc[0], span_id=tc[1])
+                self._task_event("task_submitted", spec, span_id=tc[1])
             self._submit_actor_task(spec, dep_refs)
 
         self._post_to_loop(_go)
@@ -2120,9 +2227,8 @@ class CoreWorker:
             # their zero-event hot path (the latency histogram below is the
             # always-on signal).
             spec._exec_ctx = (tc[0], _tracing.new_span_id())  # type: ignore[attr-defined]
-            self._event("task_exec_start", task_id=spec.task_id.hex(),
-                        fn=spec.method_name[:24], trace_id=tc[0],
-                        span_id=spec._exec_ctx[1], parent_id=tc[1])
+            self._task_event("task_exec_start", spec, node=self.node_id,
+                             span_id=spec._exec_ctx[1])
         t0 = time.monotonic()
         try:
             return await self._actor_runtime.execute(spec, conn)
@@ -2130,8 +2236,8 @@ class CoreWorker:
             _task_latency_actor.observe(time.monotonic() - t0)
             if tc is not None:
                 # trace id rides along so the index records the end (duration).
-                self._event("task_exec_end", task_id=spec.task_id.hex(),
-                            trace_id=tc[0], span_id=spec._exec_ctx[1])
+                self._task_event("task_exec_end", spec, node=self.node_id,
+                                 span_id=spec._exec_ctx[1])
             if streaming:
                 self._stream_cleanup(spec.task_id.binary())
 
@@ -2214,6 +2320,58 @@ class CoreWorker:
 
     def handle_health_check(self, conn, p):
         return {"ok": True, "worker_id": self.worker_id}
+
+    def handle_memory_summary(self, conn, p):
+        """Dump this process's ownership/reference picture (the `ray memory`
+        per-worker unit, reference: CoreWorkerService.GetCoreWorkerStats ->
+        memory_summary): owned objects with pin counts + borrower counts,
+        objects borrowed FROM other owners, lineage pins, and queued
+        submissions. Bounded by `limit` with an explicit truncation count."""
+        return self.memory_summary(limit=int(p.get("limit", 200)))
+
+    def memory_summary(self, limit: int = 200) -> dict:
+        owned = []
+        for oid, rec in list(self.owned.items()):
+            if len(owned) >= limit:
+                break
+            owned.append({
+                "oid": oid.hex(),
+                "state": rec.state,
+                "size": rec.size,
+                "local_refs": rec.local_refs,
+                "borrowers": rec.borrowers,
+                "where": "shm" if rec.in_shm else ("memory" if rec.in_memory else "-"),
+            })
+        borrowed = []
+        for oid_bin, ent in list(self._borrowed.items()):
+            if len(borrowed) >= limit:
+                break
+            borrowed.append({
+                "oid": ObjectID(oid_bin).hex(),
+                "owner_addr": ent["owner_addr"],
+                "refs": ent["refs"],
+            })
+        rt = self._actor_runtime
+        return {
+            "worker_id": self.worker_id,
+            "address": self.address,
+            "node_id": self.node_id,
+            "actor_id": rt.spec.actor_id.hex() if rt is not None else "",
+            "actor_name": rt.spec.name if rt is not None else "",
+            "owned": owned,
+            "owned_total": len(self.owned),
+            "owned_truncated": max(0, len(self.owned) - len(owned)),
+            "borrowed": borrowed,
+            "borrowed_total": len(self._borrowed),
+            "borrowed_truncated": max(0, len(self._borrowed) - len(borrowed)),
+            "memory_store_objects": len(self.memory_store),
+            "lineage": {"tasks": len(self._lineage), "bytes": self._lineage_bytes},
+            "queued": {
+                "submitter": sum(len(s.queue) for s in self._submitters.values()),
+                "actor_pump": sum(q.qsize() for q in self._actor_send_queues.values()),
+                "inflight_deps": len(self._inflight_deps),
+            },
+        }
 
     def handle_debug_observability(self, conn, p):
         """Ground-truth snapshot of this worker's observability state (used

@@ -22,9 +22,13 @@ h3{margin-bottom:0.1em}.muted{color:#777;font-size:0.85em}
 </style>
 <h2>ray_tpu cluster</h2>
 <div class=muted>auto-refreshes every 3s —
-<a href=/api/cluster>cluster</a> · <a href=/api/events>events</a> ·
+<a href=/api/cluster>cluster</a> · <a href=/api/tasks>tasks</a> ·
+<a href=/api/actors>actors</a> · <a href=/api/objects>objects</a> ·
+<a href=/api/summary>summary</a> · <a href=/api/memory>memory</a> ·
+<a href=/api/events>events</a> ·
 <a href=/api/metrics>metrics</a> · <a href=/api/traces>traces</a> ·
 <a href=/api/jobs>jobs</a> · <a href=/metrics>prometheus</a> ·
+task filters: <code>/api/tasks?state=RUNNING&fn=NAME&node=ID&limit=50</code> ·
 profile a worker: <code>/api/profile?addr=IP:PORT&duration=2</code> ·
 trace search: <code>/api/traces?q=NAME</code>, one trace: <code>/api/traces?id=TRACE_ID</code></div>
 <h3>Nodes</h3><table id=nodes></table>
@@ -73,6 +77,33 @@ def _payload(path: str):
             return {"error": "pass ?addr=IP:PORT (see /api/cluster actors)"}
         duration = float((q.get("duration") or ["2.0"])[0])
         return api.profile_worker(addr, duration)
+    if path.startswith(("/api/tasks", "/api/actors", "/api/objects", "/api/summary")):
+        # State API passthrough (reference: dashboard state-api routes).
+        # Filters ride the query string: ?state=RUNNING&node=..&fn=..&job=..
+        # &limit=..; /api/tasks?id=<task_id> fetches one task's attempts.
+        from urllib.parse import parse_qs, urlsplit
+
+        from ray_tpu import state as _state
+
+        u = urlsplit(path)
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        limit = int(q.get("limit", 100))
+        if u.path == "/api/tasks":
+            if q.get("id"):
+                return _state.get_task(q["id"])
+            return _state.list_tasks(state=q.get("state"), node=q.get("node"),
+                                     fn=q.get("fn"), job=q.get("job"), limit=limit)
+        if u.path == "/api/actors":
+            return _state.list_actors(state=q.get("state"), node=q.get("node"),
+                                      name=q.get("name"), job=q.get("job"), limit=limit)
+        if u.path == "/api/objects":
+            return _state.list_objects(node=q.get("node"), limit=limit)
+        if u.path == "/api/summary":
+            return _state.summary_tasks(job=q.get("job"))
+    if path == "/api/memory":
+        from ray_tpu import state as _state
+
+        return _state.memory_summary()
     if path == "/api/cluster":
         return core._run(core.controller.call("get_cluster_state", {}))
     if path.startswith("/api/events"):

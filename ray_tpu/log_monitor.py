@@ -44,6 +44,7 @@ class LogMonitor:
         self.publish = publish
         self.poll_interval_s = poll_interval_s
         self._offsets: dict[str, int] = {}
+        self._inodes: dict[str, int] = {}
         self._partial: dict[str, bytes] = {}
         self._stopped = False
 
@@ -87,16 +88,30 @@ class LogMonitor:
 
     def _read_new_lines(self, name: str, path: str) -> list[str]:
         try:
-            size = os.path.getsize(path)
+            st = os.stat(path)
         except OSError:
             return []
+        size = st.st_size
         off = self._offsets.get(name)
         if off is None:
             off = max(0, size - MAX_BACKLOG_BYTES)
+        elif self._inodes.get(name, st.st_ino) != st.st_ino:
+            # Rotated: a NEW file replaced the path (copytruncate-style
+            # rotation renames and recreates). Size alone cannot catch this
+            # once the replacement outgrows the old offset — without the
+            # inode check the tail would silently skip (or misalign into)
+            # the new file's bytes. Restart from the top, this same poll.
+            off = 0
+            self._partial.pop(name, None)
+        elif size < off:
+            # Truncated in place: restart from the top, this same poll —
+            # a shrunk file must reset the read offset instead of silently
+            # never emitting again.
+            off = 0
+            self._partial.pop(name, None)
+        self._inodes[name] = st.st_ino
         if size <= off:
-            if size < off:  # truncated/rotated: restart from the top
-                self._offsets[name] = 0
-                self._partial.pop(name, None)
+            self._offsets[name] = off
             return []
         try:
             with open(path, "rb") as f:

@@ -383,3 +383,330 @@ def add_stop_parser(sub) -> None:
                     help="seconds to wait for graceful exit before SIGKILL")
 
 
+# ---------------------------------------------------------------------------
+# state CLI: list | summary | memory | status | logs
+# (reference: `ray list|summary|memory|status|logs` over python/ray/util/state)
+# ---------------------------------------------------------------------------
+
+def _connect_driver(address: str | None):
+    """Connect this CLI process as a driver (token auto-discovery included)."""
+    import ray_tpu as rt
+
+    addr = address or os.environ.get("RAYTPU_ADDRESS") or head_address()
+    if not addr:
+        print("error: no --address, RAYTPU_ADDRESS unset, and no local head "
+              "(start one: python -m ray_tpu start --head)", file=sys.stderr)
+        sys.exit(2)
+    rt.init(address=addr, log_to_driver=False)
+    return rt
+
+
+def _rows(title: str, header: list, rows: list, note: str = ""):
+    print(f"== {title} ==")
+    if not rows:
+        print("  (none)")
+    else:
+        widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
+        for r in [header] + rows:
+            print("  " + "  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    if note:
+        print(f"  {note}")
+
+
+def _trunc_note(out: dict, shown: int) -> str:
+    bits = []
+    if out.get("truncated"):
+        bits.append(f"showing {shown} of {out['total']} (use --limit)")
+    if out.get("evicted"):
+        bits.append(f"{out['evicted']} older records evicted from the bounded index")
+    return "; ".join(bits)
+
+
+def _task_duration(record: dict) -> str:
+    """RUNNING->end wall time from the per-state timestamps, best effort."""
+    times = record.get("times", {})
+    start = times.get("RUNNING")
+    end = times.get("exec_end") or times.get("FINISHED") or times.get("FAILED")
+    if start is None:
+        return "-"
+    if end is None:
+        return f"{max(0.0, time.time() - start):.1f}s+"
+    return f"{max(0.0, end - start):.3f}s"
+
+
+def cmd_list(args) -> None:
+    _connect_driver(args.address)
+    from ray_tpu import state
+    from ray_tpu.core import api
+
+    kind = args.kind
+    if kind == "tasks":
+        out = state.list_tasks(state=args.state, node=args.node, fn=args.fn,
+                               job=args.job, limit=args.limit)
+        rows = [
+            # Full task id: TaskIDs are process-prefix + counter, so a short
+            # prefix is identical for every task one submitter minted.
+            [t["task_id"], t["attempt"], t.get("state", "?"),
+             (t.get("fn") or "-")[:32],
+             (t.get("node_id") or "-")[:12], (t.get("worker_id") or "-")[:12],
+             _task_duration(t), t.get("error_type", "")]
+            for t in out["tasks"]
+        ]
+        _rows("tasks", ["task_id", "att", "state", "fn", "node", "worker", "dur", "error"],
+              rows, note=_trunc_note(out, len(rows)))
+    elif kind == "actors":
+        out = state.list_actors(state=args.state, node=args.node, name=args.fn,
+                                job=args.job, limit=args.limit)
+        rows = [
+            [a["actor_id"][:12], a["state"], a["name"] or "-",
+             (a.get("node_id") or "-")[:12], (a.get("worker_id") or "-")[:12],
+             a["restarts"], (a.get("death_cause") or "")[:40]]
+            for a in out["actors"]
+        ]
+        _rows("actors", ["actor_id", "state", "name", "node", "worker", "restarts", "death_cause"],
+              rows, note=_trunc_note(out, len(rows)))
+    elif kind == "objects":
+        out = state.list_objects(node=args.node, limit=args.limit)
+        rows = [
+            [o["oid"][:16], o["size"], ",".join(n[:12] for n in o["locations"])]
+            for o in out["objects"]
+        ]
+        _rows("objects (shared/shm directory)", ["object_id", "bytes", "nodes"], rows,
+              note=_trunc_note(out, len(rows)) or f"{out['total']} objects, {out['total_bytes'] / 1e6:.1f} MB total")
+    elif kind == "nodes":
+        out = state.list_nodes(state=args.state, limit=args.limit)
+        rows = []
+        for n in out["nodes"]:
+            store = n.get("store") or {}
+            occ = (f"{store.get('used', 0) / 1e6:.1f}/{store.get('capacity', 0) / 1e6:.0f}MB"
+                   if store else "-")
+            res = " ".join(
+                f"{k}:{n['resources_available'].get(k, 0):g}/{v:g}"
+                for k, v in sorted(n["resources_total"].items())
+            )
+            rows.append([n["node_id"][:12],
+                         n["state"] + (" (draining)" if n.get("draining") else ""),
+                         n["address"], res, occ, n.get("workers", 0)])
+        _rows("nodes", ["node_id", "state", "address", "avail/total", "store", "workers"],
+              rows, note=_trunc_note(out, len(rows)))
+    elif kind == "workers":
+        out = state.list_workers(state=args.state, node=args.node, limit=args.limit)
+        rows = [
+            [w["worker_id"][:12], w["node_id"][:12], w["state"], w["address"], w["actors"]]
+            for w in out["workers"]
+        ]
+        _rows("workers", ["worker_id", "node", "state", "address", "actors"],
+              rows, note=_trunc_note(out, len(rows)))
+    elif kind == "pgs":
+        s = api._cluster_state()
+        _rows("placement groups", ["pg_id", "state", "strategy", "bundles"], [
+            [pid[:12], g["state"], g["strategy"], len(g["bundles"])]
+            for pid, g in s["placement_groups"].items()
+        ])
+    elif kind == "jobs":
+        from ray_tpu.job import JobSubmissionClient
+
+        _rows("jobs", ["job_id", "status", "entrypoint"], [
+            [j["job_id"], j["status"], j["entrypoint"][:48]]
+            for j in JobSubmissionClient().list_jobs()
+        ])
+
+
+def cmd_summary(args) -> None:
+    _connect_driver(args.address)
+    from ray_tpu import state
+    from ray_tpu.core import task_state as ts
+
+    out = state.summary_tasks(job=args.job)
+    states = list(ts.STATES)
+    rows = []
+    for fn, ent in sorted(out["summary"].items(), key=lambda kv: -kv[1]["total"]):
+        rows.append([fn[:40], ent["total"]] + [ent["states"].get(s, 0) for s in states])
+    _rows("task summary (by function)", ["fn", "total"] + states, rows,
+          note=(f"{out['total_tasks']} indexed task attempts"
+                + (f"; {out['evicted']} evicted from the bounded index" if out["evicted"] else "")))
+
+
+def cmd_memory(args) -> None:
+    _connect_driver(args.address)
+    from ray_tpu import state
+
+    out = state.memory_summary(limit=args.limit)
+
+    def render_worker(w: dict, indent: str = "  "):
+        if "error" in w:
+            print(f"{indent}worker {w.get('worker_id', '?')[:12]}: error: {w['error']}")
+            return
+        who = w["worker_id"][:12]
+        if w.get("actor_name") or w.get("actor_id"):
+            who += f" (actor {w.get('actor_name') or w['actor_id'][:12]})"
+        q = w.get("queued", {})
+        print(f"{indent}worker {who}  owned={w['owned_total']} borrowed={w['borrowed_total']} "
+              f"memstore={w['memory_store_objects']} lineage={w['lineage']['tasks']}"
+              f"/{w['lineage']['bytes']}B queued={q.get('submitter', 0)}+{q.get('actor_pump', 0)}")
+        for o in w.get("owned", []):
+            if args.all or o["borrowers"] > 0 or o["size"] >= 1024:
+                print(f"{indent}  owns {o['oid'][:16]}  {o['size']}B {o['where']} "
+                      f"state={o['state']} local_refs={o['local_refs']} borrowers={o['borrowers']}")
+        if w.get("owned_truncated"):
+            print(f"{indent}  ... {w['owned_truncated']} more owned (use --limit)")
+        for b in w.get("borrowed", []):
+            print(f"{indent}  borrows {b['oid'][:16]}  from {b['owner_addr']} refs={b['refs']}")
+        if w.get("borrowed_truncated"):
+            print(f"{indent}  ... {w['borrowed_truncated']} more borrowed (use --limit)")
+
+    for node in out.get("nodes", []):
+        store = node.get("store", {})
+        print(f"node {node.get('node_id', '?')[:12]}  store "
+              f"{store.get('used', 0) / 1e6:.1f}/{store.get('capacity', 0) / 1e6:.0f}MB "
+              f"({store.get('num_objects', 0)} objects)")
+        for w in node.get("workers", []):
+            render_worker(w)
+    if "driver" in out:
+        print("driver")
+        render_worker(out["driver"])
+
+
+def cmd_status(args) -> None:
+    """Cluster resources + pending demand (reference: `ray status` — node
+    table from GCS + autoscaler ClusterResourceState demand)."""
+    _connect_driver(args.address)
+    from ray_tpu import state
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    s = api._cluster_state()
+    auto = core._run(core.controller.call("get_autoscaler_state", {}))
+    nodes = s["nodes"]
+    alive = [n for n in nodes.values() if n["state"] == "ALIVE"]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    total: dict = {}
+    avail: dict = {}
+    for n in alive:
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0) + v
+        for k, v in n["resources_available"].items():
+            avail[k] = avail.get(k, 0) + v
+    print("resources:")
+    for k in sorted(total):
+        print(f"  {k}: {total[k] - avail.get(k, 0):g}/{total[k]:g} used")
+    stores = [n.get("store") or {} for n in state.list_nodes()["nodes"]]
+    used = sum(st.get("used", 0) for st in stores)
+    cap = sum(st.get("capacity", 0) for st in stores)
+    print(f"object store: {used / 1e6:.1f}/{cap / 1e6:.0f} MB across "
+          f"{len(stores)} node(s); {s['objects']['count']} shared objects "
+          f"({s['objects']['bytes'] / 1e6:.1f} MB tracked)")
+    print("pending demand:")
+    pending = auto.get("pending", [])
+    gangs = auto.get("pending_gangs", [])
+    if not pending and not gangs:
+        print("  (none — no queued leases, actors, or gangs)")
+    for item in pending:
+        sel = f" selector={item['label_selector']}" if item.get("label_selector") else ""
+        print(f"  {item['kind']}: {item['demand']}{sel}")
+    for gang in gangs:
+        print(f"  gang ({gang['strategy']}): {gang['bundles']}")
+    n_alive_actors = sum(1 for a in s["actors"].values() if a["state"] == "ALIVE")
+    print(f"actors: {n_alive_actors} alive / {len(s['actors'])} total; "
+          f"placement groups: {len(s['placement_groups'])}")
+
+
+def cmd_logs(args) -> None:
+    """Fetch (and optionally follow) one worker's or actor's logs: the
+    backlog comes from the hosting daemon's log files (tail_worker_log),
+    live lines from the controller's `logs` pubsub (log_monitor feed)."""
+    rt = _connect_driver(args.address)
+    from ray_tpu import state
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    target = args.target
+    worker_id = node_id = ""
+    for w in state.list_workers()["workers"]:
+        if w["worker_id"].startswith(target):
+            worker_id, node_id = w["worker_id"], w["node_id"]
+            break
+    if not worker_id:
+        for a in state.list_actors(limit=1000)["actors"]:
+            if a["name"] == target or a["actor_id"].startswith(target):
+                worker_id, node_id = a["worker_id"], a["node_id"]
+                break
+    if not worker_id:
+        print(f"error: no worker or actor matching {target!r} "
+              f"(see `list workers` / `list actors`)", file=sys.stderr)
+        sys.exit(2)
+    nodes = {n["node_id"]: n for n in state.list_nodes()["nodes"]
+             if n["state"] == "ALIVE"}
+    node = nodes.get(node_id)
+    # A dead/restarted record loses its node attribution; the log files may
+    # still exist on whichever daemon hosted the worker — ask them all.
+    candidates = [node] if node is not None else list(nodes.values())
+    if not candidates:
+        print(f"error: no live node to ask for {worker_id[:12]}'s logs", file=sys.stderr)
+        sys.exit(2)
+
+    async def backlog(addr):
+        conn = await core._daemon_conn(addr)
+        return await conn.call(
+            "tail_worker_log", {"worker_id": worker_id, "max_bytes": args.max_bytes}
+        )
+
+    tail = {}
+    for cand in candidates:
+        try:
+            tail = core._run(backlog(cand["address"]))
+        except Exception:
+            continue
+        if tail:
+            break
+    for wid, streams in tail.items():
+        for stream in ("stdout", "stderr"):
+            for line in streams.get(stream, []):
+                print(f"[{stream}] {line}")
+    if not args.follow:
+        return
+    print(f"-- following {worker_id[:12]} (ctrl-c to stop) --", flush=True)
+
+    def on_logs(_key, data):
+        if not str(data.get("worker_id", "")).startswith(worker_id[:12]):
+            return
+        stream = data.get("stream", "stdout")
+        for line in data.get("lines", ()):
+            print(f"[{stream}] {line}", flush=True)
+
+    core._run(core.subscribe_channel("logs", on_logs))
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+def add_state_parsers(sub) -> None:
+    lp = sub.add_parser("list", help="list tasks/actors/objects/nodes/workers/pgs/jobs")
+    lp.add_argument("kind", choices=["tasks", "actors", "objects", "nodes",
+                                     "workers", "pgs", "jobs"])
+    lp.add_argument("--state", default=None,
+                    help="filter by FSM state (tasks: RUNNING, FINISHED, ...; "
+                         "actors: ALIVE, DEAD, ...)")
+    lp.add_argument("--node", default=None, help="filter by node id prefix")
+    lp.add_argument("--fn", default=None,
+                    help="filter by function/actor-name substring")
+    lp.add_argument("--job", default=None, help="filter by job id prefix")
+    lp.add_argument("--limit", type=int, default=100)
+    sp = sub.add_parser("summary", help="per-function task rollup")
+    sp.add_argument("kind", nargs="?", default="tasks", choices=["tasks"])
+    sp.add_argument("--job", default=None)
+    mp = sub.add_parser("memory", help="cluster-wide object ownership/reference tables")
+    mp.add_argument("--limit", type=int, default=200)
+    mp.add_argument("--all", action="store_true",
+                    help="print every owned object (default: borrowed/large only)")
+    sub.add_parser("status", help="cluster resources + pending demand")
+    gp = sub.add_parser("logs", help="fetch/follow a worker's or actor's logs")
+    gp.add_argument("target", help="worker id prefix, actor name, or actor id prefix")
+    gp.add_argument("-f", "--follow", action="store_true",
+                    help="keep streaming new lines via the logs pubsub")
+    gp.add_argument("--max-bytes", type=int, default=64 * 1024,
+                    help="backlog bytes to fetch per stream")
+
+

@@ -45,6 +45,15 @@ _ctx: contextvars.ContextVar[Optional[tuple]] = contextvars.ContextVar(
 _trace_all = os.environ.get("RAYTPU_TRACE", "") in ("1", "true", "on")
 
 
+def now() -> float:
+    """THE event/span timestamp clock. Every producer on the observability
+    plane (worker `_event`/`_task_event`, controller `_event`, Span,
+    `event()`) stamps through here, so state-index timings and span timings
+    land on one comparable timeline — swap the time source in one place,
+    never per-emitter."""
+    return time.time()
+
+
 def set_trace_enabled(on: bool):
     """Enable auto-root spans for ingress points that support them (the
     serve HTTP proxy traces every request when on; individual requests can
@@ -117,7 +126,7 @@ class Span:
 
     def __enter__(self) -> "Span":
         self._token = _ctx.set((self.trace_id, self.span_id))
-        self._t0 = time.time()
+        self._t0 = now()
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -128,7 +137,7 @@ class Span:
             "span_id": self.span_id,
             "parent_id": self.parent_id,
             "ts": self._t0,
-            "dur": time.time() - self._t0,
+            "dur": now() - self._t0,
         }
         if self.attrs:
             ev["attrs"] = self.attrs
@@ -155,7 +164,7 @@ def event(name: str, **attrs):
         "trace_id": ctx[0],
         "span_id": new_span_id(),
         "parent_id": ctx[1],
-        "ts": time.time(),
+        "ts": now(),
         "dur": 0.0,
     }
     if attrs:

@@ -49,6 +49,52 @@ def test_tailer_stderr_and_truncation(tmp_path):
     assert batches[-1]["lines"] == ["x"]
 
 
+def test_tailer_truncation_emits_same_poll(tmp_path):
+    """A shrunk file resets the read offset AND re-reads in the same poll —
+    no silent gap until the next write lands."""
+    batches = []
+
+    async def publish(b):
+        batches.append(b)
+
+    mon = LogMonitor(str(tmp_path), publish)
+    p = tmp_path / "worker-w3.out"
+    p.write_bytes(b"one\ntwo\n")
+    _run(mon.poll_once())
+    assert batches[-1]["lines"] == ["one", "two"]
+    p.write_bytes(b"fresh\n")  # in-place truncate + rewrite, smaller
+    _run(mon.poll_once())
+    assert batches[-1]["lines"] == ["fresh"]
+
+
+def test_tailer_rotation_new_inode_resets_offset(tmp_path):
+    """Rotation replaces the path with a NEW file. When the replacement has
+    already grown past the old offset, size alone cannot detect it — the
+    inode check must reset the offset or lines are skipped/garbled."""
+    batches = []
+
+    async def publish(b):
+        batches.append(b)
+
+    mon = LogMonitor(str(tmp_path), publish)
+    p = tmp_path / "worker-w4.out"
+    p.write_bytes(b"aaaa\n")
+    _run(mon.poll_once())
+    assert batches[-1]["lines"] == ["aaaa"]
+    # Rotate: move the old file away, recreate the path BIGGER than the old
+    # offset (5 bytes) so the size heuristic alone would not fire.
+    os.rename(p, tmp_path / "worker-w4.out.1")
+    p.write_bytes(b"rotated-1\nrotated-2\n")
+    assert os.path.getsize(p) > 5
+    _run(mon.poll_once())
+    assert batches[-1]["lines"] == ["rotated-1", "rotated-2"]
+    # Tailing continues from the new file's offset afterwards.
+    with open(p, "ab") as f:
+        f.write(b"rotated-3\n")
+    _run(mon.poll_once())
+    assert batches[-1]["lines"] == ["rotated-3"]
+
+
 def test_tailer_skips_huge_backlog(tmp_path):
     from ray_tpu import log_monitor as lm
 

@@ -236,7 +236,8 @@ def test_dashboard_api_events_surfaces_drops(shared_ray, dash):
     payload = json.loads(body)
     assert "events" in payload
     assert set(payload["dropped"]) == {
-        "controller_events", "task_events", "worker_events", "traces_evicted"
+        "controller_events", "task_events", "worker_events", "traces_evicted",
+        "tasks_evicted",
     }
 
 
