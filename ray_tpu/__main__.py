@@ -120,12 +120,15 @@ def cmd_profile(args):
 def main(argv=None):
     from ray_tpu import scripts
 
+    from ray_tpu.analysis.cli import add_lint_parser, cmd_lint
+
     p = argparse.ArgumentParser(prog="ray_tpu")
     p.add_argument("--address", default=None, help="controller address host:port")
     sub = p.add_subparsers(dest="cmd", required=True)
     scripts.add_start_parser(sub)
     scripts.add_stop_parser(sub)
     scripts.add_state_parsers(sub)  # list | summary | memory | status | logs
+    add_lint_parser(sub)  # pure source-tree pass; never connects
     ep = sub.add_parser("events")
     ep.add_argument("--limit", type=int, default=100)
     sub.add_parser("metrics")
@@ -150,6 +153,8 @@ def main(argv=None):
     pr.add_argument("--top", type=int, default=10)
     pr.add_argument("--depth", type=int, default=4)
     args = p.parse_args(argv)
+    if args.cmd == "lint":
+        sys.exit(cmd_lint(args))
     if args.cmd == "start":
         sys.exit(scripts.cmd_start(args))
     if args.cmd == "stop":

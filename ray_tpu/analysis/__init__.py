@@ -1,0 +1,27 @@
+"""graftlint: AST-based invariant checks for the async runtime.
+
+Usage:
+    python -m ray_tpu lint [--json] [paths...]
+
+or programmatically::
+
+    from ray_tpu.analysis import lint_paths, lint_source
+    result = lint_paths(["ray_tpu/"])
+    assert not result.findings
+
+See engine.py for the framework (one parse per file, rule visitors
+multiplexed over a single walk, inline suppressions with required reasons)
+and rules_*.py for the shipped rules.
+"""
+from ray_tpu.analysis.engine import (  # noqa: F401
+    BAD_SUPPRESSION,
+    UNUSED_SUPPRESSION,
+    FileContext,
+    Finding,
+    LintResult,
+    Rule,
+    Suppression,
+    default_rules,
+    lint_paths,
+    lint_source,
+)

@@ -1,0 +1,59 @@
+"""`python -m ray_tpu lint` — run graftlint over the tree.
+
+Exits non-zero on any finding (the CI contract: the committed tree is always
+at zero). ``--json`` emits the stable machine-readable report (rule ->
+[file:line ...] plus the suppression inventory) that the tier-1 wrapper test
+writes to LINT.json, so the trajectory of findings and suppressions is
+diffable across PRs. Unlike every other subcommand, lint never connects to a
+cluster — it is a pure source-tree pass.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def default_target() -> str:
+    """The installed ray_tpu package directory (lint the shipped tree when
+    no paths are given)."""
+    import ray_tpu
+
+    return os.path.dirname(os.path.abspath(ray_tpu.__file__))
+
+
+def add_lint_parser(sub) -> None:
+    lp = sub.add_parser(
+        "lint",
+        help="AST invariant checks for the async runtime (graftlint)",
+        description=(
+            "Single-pass AST analysis enforcing the invariants this codebase "
+            "established the hard way: bg-strong-ref, no-blocking-in-async, "
+            "mac-before-pickle, counted-trims, loop-thread-race, fsm-emitter. "
+            "Suppress a finding inline with "
+            "'# graftlint: disable=<rule>  <reason>' — the reason is required."
+        ),
+    )
+    lp.add_argument("paths", nargs="*", help="files/dirs to lint (default: the ray_tpu package)")
+    lp.add_argument("--json", action="store_true", help="machine-readable report on stdout")
+
+
+def cmd_lint(args) -> int:
+    from ray_tpu.analysis import lint_paths
+
+    paths = args.paths or [default_target()]
+    result = lint_paths(paths)
+    if args.json:
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for path, msg in result.errors:
+            print(f"{path}: ERROR {msg}", file=sys.stderr)
+        n = len(result.findings)
+        sup = len(result.suppressions)
+        print(
+            f"graftlint: {n} finding{'s' if n != 1 else ''} in {result.files} "
+            f"files ({sup} suppressed with reasons)"
+        )
+    return 1 if (result.findings or result.errors) else 0

@@ -1,0 +1,408 @@
+"""graftlint engine: one parse per file, rule visitors multiplexed over one walk.
+
+The invariants this codebase learned the hard way (GC-killed fire-and-forget
+asyncio tasks, blocking calls on the event-loop thread, pickle of
+unauthenticated wire bytes, silent bounded-buffer trims) keep re-appearing as
+review comments. This package machine-checks them: each rule is an AST
+visitor; the engine parses each file ONCE and drives every applicable rule
+over a single depth-first walk (lexical order, parent links and scope stacks
+maintained by the engine so rules stay small).
+
+Suppression: ``# graftlint: disable=<rule>[,<rule>...]  <reason>`` on the
+finding's line. The reason is REQUIRED — a disable comment without one does
+not suppress and is itself reported (rule id ``bad-suppression``). Reasons
+are carried into the JSON report so the suppression inventory is diffable
+across PRs.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize as _tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+# Matches the inline disable directive (syntax in the module docstring —
+# spelling it here would make this comment parse as a directive itself).
+# The rule list tolerates spaces around commas ("rule-a, rule-b").
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable="
+    r"([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)[ \t]*[-—:]*[ \t]*(.*?)\s*$"
+)
+
+BAD_SUPPRESSION = "bad-suppression"
+UNUSED_SUPPRESSION = "unused-suppression"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation: file:line, rule id, one-line explanation."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Suppression:
+    path: str
+    line: int
+    rules: tuple
+    reason: str
+
+
+class Rule:
+    """Base class for graftlint rules.
+
+    Subclasses set ``id`` and ``explanation`` and override any of the hook
+    methods. ``visit`` runs on every node in document order (parents before
+    children); ``leave`` runs after a node's subtree completes. Rules report
+    via ``ctx.report(node_or_line, message)``.
+    """
+
+    id: str = ""
+    explanation: str = ""
+
+    def applies_to(self, path: str) -> bool:
+        return True
+
+    def begin_file(self, ctx: "FileContext") -> None:
+        pass
+
+    def visit(self, node: ast.AST, ctx: "FileContext") -> None:
+        pass
+
+    def leave(self, node: ast.AST, ctx: "FileContext") -> None:
+        pass
+
+    def end_file(self, ctx: "FileContext") -> None:
+        pass
+
+
+class FileContext:
+    """Per-file state the engine maintains for every rule: source path and
+    lines, parent links, and the enclosing function/class stacks."""
+
+    def __init__(self, path: str, tree: ast.Module, lines: list):
+        self.path = path
+        self.tree = tree
+        self.lines = lines  # 0-indexed source lines (for suppression lookup)
+        self.parents: dict = {}
+        # Innermost-last stacks. func_stack holds FunctionDef/AsyncFunctionDef
+        # nodes; class_stack holds ClassDef nodes.
+        self.func_stack: list = []
+        self.class_stack: list = []
+        self._raw_findings: dict = {}  # rule_id -> [ (line, message) ]
+        self.stats: dict = {}  # rule_id -> arbitrary JSON-able stats
+
+    # -- helpers rules lean on ------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def in_async_context(self) -> bool:
+        """True when the innermost enclosing function is ``async def`` — a
+        nested plain ``def`` (executor thunk, callback) exits the async
+        context even though an async function encloses it lexically."""
+        return bool(self.func_stack) and isinstance(
+            self.func_stack[-1], ast.AsyncFunctionDef
+        )
+
+    def outermost_function(self) -> Optional[ast.AST]:
+        return self.func_stack[0] if self.func_stack else None
+
+    def report(self, rule: Rule, node, message: str = "") -> None:
+        """``node`` may be an AST node, a bare line int, or a
+        ``(line, end_line)`` span. The whole extent matters: a disable
+        comment belongs on the line a formatter puts it — often the CLOSING
+        line of a multi-line statement — and must still match, so rules
+        that buffer findings keep spans, not bare ints."""
+        if isinstance(node, int):
+            line = end = node
+        elif isinstance(node, tuple):
+            line, end = node
+        else:
+            line = getattr(node, "lineno", 0)
+            end = getattr(node, "end_lineno", None) or line
+        self._raw_findings.setdefault(rule.id, []).append(
+            (line, end, message or rule.explanation)
+        )
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything dynamic."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def parse_suppressions(path: str, source: str) -> list:
+    """Disable directives from actual COMMENT tokens only — a
+    "# graftlint: disable=..." spelled inside a string literal (test
+    fixtures, docs) is data, not a directive."""
+    out = []
+    if "graftlint" not in source:
+        return out
+    try:
+        tokens = list(_tokenize.generate_tokens(io.StringIO(source).readline))
+    except (_tokenize.TokenError, IndentationError, SyntaxError):
+        return out  # un-tokenizable source already surfaced as a parse error
+    for tok in tokens:
+        if tok.type != _tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if m is None:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        out.append(Suppression(path, tok.start[0], rules, m.group(2).strip()))
+    return out
+
+
+@dataclass
+class LintResult:
+    findings: list = field(default_factory=list)  # [Finding]
+    suppressions: list = field(default_factory=list)  # [Suppression] (valid ones)
+    stats: dict = field(default_factory=dict)  # path -> {rule_id: stats}
+    files: int = 0
+    errors: list = field(default_factory=list)  # [(path, message)] parse failures
+
+    def to_json(self) -> dict:
+        """Stable machine-readable report: rule -> sorted [file:line ...].
+        Written to LINT.json by the tier-1 wrapper test so the trajectory of
+        findings AND suppressions is diffable across PRs."""
+        rules: dict = {}
+        for f in sorted(self.findings, key=lambda f: (f.rule, f.path, f.line)):
+            rules.setdefault(f.rule, []).append(f.render())
+        sups = [
+            {"at": f"{s.path}:{s.line}", "rules": list(s.rules), "reason": s.reason}
+            for s in sorted(self.suppressions, key=lambda s: (s.path, s.line))
+        ]
+        return {
+            "version": 1,
+            "files": self.files,
+            "total": len(self.findings),
+            "rules": rules,
+            "suppressions": sups,
+            "errors": [f"{p}: {m}" for p, m in sorted(self.errors)],
+        }
+
+
+def default_rules() -> list:
+    """Fresh instances of every shipped rule (rules keep per-run state)."""
+    from ray_tpu.analysis.rules_async import (
+        BgStrongRef,
+        LoopThreadRace,
+        NoBlockingInAsync,
+    )
+    from ray_tpu.analysis.rules_buffers import CountedTrims
+    from ray_tpu.analysis.rules_fsm import FsmEmitter
+    from ray_tpu.analysis.rules_security import MacBeforePickle
+
+    return [
+        BgStrongRef(),
+        NoBlockingInAsync(),
+        MacBeforePickle(),
+        CountedTrims(),
+        LoopThreadRace(),
+        FsmEmitter(),
+    ]
+
+
+def lint_source(
+    source: str, path: str = "<string>", rules: Optional[list] = None
+) -> LintResult:
+    """Lint one source string (the test-fixture entry point)."""
+    result = LintResult()
+    _lint_one(source, path, default_rules() if rules is None else rules, result)
+    result.files = 1
+    return result
+
+
+def _lint_one(source: str, path: str, rules: list, result: LintResult) -> None:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        result.errors.append((path, f"syntax error: {e}"))
+        return
+    lines = source.splitlines()
+    active = [r for r in rules if r.applies_to(path)]
+    ctx = FileContext(path, tree, lines)
+    for rule in active:
+        rule.begin_file(ctx)
+    _walk(tree, active, ctx)
+    for rule in active:
+        rule.end_file(ctx)
+    if ctx.stats:
+        result.stats[path] = ctx.stats
+
+    # Suppression pass: a disable WITH a reason silences same-line findings
+    # of the named rules; a disable WITHOUT one silences nothing and is
+    # itself a finding (the reason string is the whole point — it is the
+    # written record of why the invariant does not apply here). A reasoned
+    # disable that matches NOTHING is also a finding: the violation it
+    # excused was fixed, so the stale comment must go before it silently
+    # masks a future regression reintroduced on that line.
+    by_line: dict = {}
+    known_ids = {r.id for r in rules} | {BAD_SUPPRESSION, UNUSED_SUPPRESSION}
+    for s in parse_suppressions(path, source):
+        # The comma continuation of the rule list can swallow the first
+        # word of a prose reason ("disable=<rule>, intentional"): trailing
+        # tokens that are not known rule ids belong to the reason.
+        ids = list(s.rules)
+        cut = next((i for i, r in enumerate(ids) if r not in known_ids), None)
+        if cut is not None:
+            s = Suppression(
+                s.path,
+                s.line,
+                tuple(ids[:cut]),
+                " ".join(ids[cut:] + ([s.reason] if s.reason else [])),
+            )
+        if not s.rules:
+            result.findings.append(
+                Finding(
+                    BAD_SUPPRESSION,
+                    path,
+                    s.line,
+                    f"graftlint suppression names no known rule ({ids[0]!r} "
+                    "is not a rule id)",
+                )
+            )
+            continue
+        if not s.reason:
+            result.findings.append(
+                Finding(
+                    BAD_SUPPRESSION,
+                    path,
+                    s.line,
+                    "graftlint suppression without a reason — write why the "
+                    "invariant does not apply here",
+                )
+            )
+            continue
+        by_line.setdefault(s.line, []).append(s)
+    used: set = set()
+    for rule in active:
+        for line, end, message in ctx._raw_findings.get(rule.id, ()):
+            sup = next(
+                (
+                    s
+                    for ln in range(line, end + 1)
+                    for s in by_line.get(ln, ())
+                    if rule.id in s.rules
+                ),
+                None,
+            )
+            if sup is not None:
+                used.add(id(sup))
+                continue
+            result.findings.append(Finding(rule.id, path, line, message))
+    for sups in by_line.values():
+        for s in sups:
+            if id(s) in used:
+                result.suppressions.append(s)
+            else:
+                result.findings.append(
+                    Finding(
+                        UNUSED_SUPPRESSION,
+                        path,
+                        s.line,
+                        f"suppression for {'/'.join(s.rules)} matches no "
+                        "finding on this line — remove the stale disable",
+                    )
+                )
+
+
+def iter_py_files(paths: Iterable[str]):
+    seen: set = set()  # overlapping args must not double-lint a file
+
+    def once(path: str):
+        real = os.path.realpath(path)
+        if real not in seen:
+            seen.add(real)
+            yield path
+
+    for p in paths:
+        if os.path.isfile(p):
+            yield from once(p)
+            continue
+        for root, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames if not d.startswith(".") and d != "__pycache__"
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield from once(os.path.join(root, fn))
+
+
+def lint_paths(paths: Iterable[str], rules: Optional[list] = None) -> LintResult:
+    result = LintResult()
+    rules = default_rules() if rules is None else rules
+    paths = list(paths)
+    for p in paths:
+        # A typo'd path must not turn the gate green by linting nothing.
+        if not os.path.exists(p):
+            result.errors.append((p, "no such file or directory"))
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            result.errors.append((path, f"unreadable: {e}"))
+            continue
+        _lint_one(source, path, rules, result)
+        result.files += 1
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
+def _walk(node: ast.AST, rules: list, ctx: FileContext) -> None:
+    """Single document-order DFS; every rule sees every node. For function
+    nodes, ONLY the body children enter the new scope: decorators, parameter
+    defaults, and annotations evaluate at definition time on the defining
+    thread, so a ``time.sleep`` inside a decorator argument of an
+    ``async def`` is not a blocking call inside the coroutine."""
+    for rule in rules:
+        rule.visit(node, ctx)
+    is_func = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+    is_class = isinstance(node, ast.ClassDef)
+    if is_func:
+        # A lambda body is deferred code exactly like a nested def's —
+        # `run_in_executor(None, lambda: blocking())` must not read as
+        # blocking inside the coroutine.
+        body = node.body if isinstance(node.body, list) else [node.body]
+        body_ids = set(map(id, body))
+        outer = [c for c in ast.iter_child_nodes(node) if id(c) not in body_ids]
+        for child in outer:
+            ctx.parents[child] = node
+            _walk(child, rules, ctx)
+        ctx.func_stack.append(node)
+        for child in body:
+            ctx.parents[child] = node
+            _walk(child, rules, ctx)
+        ctx.func_stack.pop()
+    else:
+        if is_class:
+            ctx.class_stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            ctx.parents[child] = node
+            _walk(child, rules, ctx)
+        if is_class:
+            ctx.class_stack.pop()
+    for rule in rules:
+        rule.leave(node, ctx)

@@ -1,0 +1,162 @@
+"""counted-trims: every bounded eviction must increment a dropped/evicted
+counter — the "no silent caps" rule (PRs 2/4: every silently-trimmed buffer
+was eventually a debugging session; raytpu_events_dropped_total{where} and
+the tasks_evicted/traces_evicted counters exist because data that vanishes
+untallied reads as "never happened").
+
+Detected trim shapes:
+  * slice deletes            ``del self.events[:trimmed]``
+  * oldest-entry evictions   ``d.pop(next(iter(d)))``
+  * bounded deques           ``deque(maxlen=N)`` (append-side discards are
+                             implicit, so the counter duty attaches to the
+                             constructor's class)
+
+A trim is counted when the same function (same class, for deques — the
+discard happens far from the constructor) also increments a ``*_dropped``/
+``*_evicted``-named counter (``+=`` or ``.inc()``).
+"""
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.analysis.engine import FileContext, Rule, dotted_name
+
+_COUNTER_MARKERS = ("dropped", "evicted", "discard", "trimmed_total")
+
+
+def _is_counter_name(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in _COUNTER_MARKERS)
+
+
+def _is_oldest_pop(node: ast.Call) -> bool:
+    """``x.pop(next(iter(x)))`` — the evict-oldest dict idiom."""
+    if not (isinstance(node.func, ast.Attribute) and node.func.attr == "pop"):
+        return False
+    if not node.args:
+        return False
+    a = node.args[0]
+    return (
+        isinstance(a, ast.Call)
+        and isinstance(a.func, ast.Name)
+        and a.func.id == "next"
+        and a.args
+        and isinstance(a.args[0], ast.Call)
+        and isinstance(a.args[0].func, ast.Name)
+        and a.args[0].func.id == "iter"
+    )
+
+
+def _span(node: ast.AST) -> tuple:
+    return (node.lineno, getattr(node, "end_lineno", None) or node.lineno)
+
+
+class _Region:
+    __slots__ = ("node", "trims", "deques", "counted")
+
+    def __init__(self, node):
+        self.node = node
+        self.trims: list = []  # ((line, end_line), what)
+        self.deques: list = []  # (line, end_line) spans of deque(maxlen=...)
+        self.counted = False
+
+
+class CountedTrims(Rule):
+    id = "counted-trims"
+    explanation = (
+        "bounded eviction with no dropped/evicted counter in the same "
+        "function — silent data loss is undebuggable; tally what you discard"
+    )
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._module = _Region(None)
+        self._funcs: list = []
+        self._classes: list = []
+
+    # -- region helpers --------------------------------------------------
+    def _mark_counted(self) -> None:
+        if self._funcs:
+            self._funcs[-1].counted = True
+        # Deques resolve at class (or module) scope.
+        (self._classes[-1] if self._classes else self._module).counted = True
+
+    def _trim_region(self) -> "_Region":
+        """Innermost enclosing region: function > class body > module."""
+        if self._funcs:
+            return self._funcs[-1]
+        return self._classes[-1] if self._classes else self._module
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._funcs.append(_Region(node))
+            return
+        if isinstance(node, ast.ClassDef):
+            self._classes.append(_Region(node))
+            return
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            dn = dotted_name(node.target)
+            if dn and _is_counter_name(dn.rsplit(".", 1)[-1]):
+                self._mark_counted()
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                # `del x[:]` (no bounds) is a full clear/consume, not a
+                # bounded eviction — only bounded slices are trims.
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.slice, ast.Slice)
+                    and (t.slice.lower is not None or t.slice.upper is not None)
+                ):
+                    self._trim_region().trims.append(
+                        (_span(node), "slice delete")
+                    )
+            return
+        if not isinstance(node, ast.Call):
+            return
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else ""
+        if attr == "inc":
+            obj = dotted_name(fn.value) if isinstance(fn, ast.Attribute) else ""
+            if _is_counter_name(obj):
+                self._mark_counted()
+            return
+        if _is_oldest_pop(node):
+            self._trim_region().trims.append((_span(node), "evict-oldest pop"))
+            return
+        name = attr or (fn.id if isinstance(fn, ast.Name) else "")
+        if name == "deque":
+            for kw in node.keywords:
+                if kw.arg == "maxlen" and not (
+                    isinstance(kw.value, ast.Constant) and kw.value.value is None
+                ):
+                    region = self._classes[-1] if self._classes else self._module
+                    region.deques.append(_span(node))
+
+    def leave(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and self._funcs:
+            self._flush(self._funcs.pop(), ctx)
+            return
+        if isinstance(node, ast.ClassDef) and self._classes:
+            self._flush(self._classes.pop(), ctx)
+
+    def end_file(self, ctx: FileContext) -> None:
+        self._flush(self._module, ctx)
+
+    def _flush(self, region: "_Region", ctx: FileContext) -> None:
+        if region.counted:
+            return
+        for span, what in region.trims:
+            ctx.report(
+                self,
+                span,
+                f"{what} with no dropped/evicted counter incremented in the "
+                "same scope — silent caps hide data loss",
+            )
+        for span in region.deques:
+            ctx.report(
+                self,
+                span,
+                "deque(maxlen=...) discards silently on append — increment a "
+                "*_dropped/*_evicted counter on the discard path (none found "
+                "in this scope)",
+            )

@@ -29,6 +29,7 @@ from ray_tpu.core import task_state as _ts
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.util import tracing as _tracing
+from ray_tpu.util.bgtasks import spawn_bg as _spawn_bg_task
 
 logger = logging.getLogger(__name__)
 
@@ -164,6 +165,11 @@ class Controller:
         self._job_counter = 0
         self._rr_counter = 0
         self._bg: list[asyncio.Task] = []
+        # Strong refs to fire-and-forget tasks (asyncio tracks tasks weakly;
+        # an unreferenced scheduling-retry task GC-killed mid-await means a
+        # pending task or actor is never placed — the exact bug class the
+        # init-task fix of PR 2 diagnosed, enforced by graftlint now).
+        self._misc_tasks: set[asyncio.Task] = set()
         self.events: list[dict] = []  # structured event log (ray_event_recorder equiv)
         self.events_dropped = 0  # control events lost to log trims
         self.task_events: list[dict] = []  # aggregated per-worker task events
@@ -201,8 +207,15 @@ class Controller:
         logger.info("controller listening on %s", addr)
         return addr
 
+    def _spawn_bg(self, coro, name: str | None = None) -> "asyncio.Task":
+        """create_task with a strong reference held until completion (the
+        bg-strong-ref invariant; see util.bgtasks)."""
+        return _spawn_bg_task(self._misc_tasks, coro, name=name)
+
     async def stop(self):
         for t in self._bg:
+            t.cancel()
+        for t in list(self._misc_tasks):
             t.cancel()
         if self.persist_path and self._dirty:
             # Final flush BEFORE closing the server: acknowledged mutations
@@ -388,9 +401,9 @@ class Controller:
                     # re-registered has a NEW conn — this close event must not
                     # kill the fresh registration.
                     if node_id in self.nodes and self.nodes[node_id].conn is c:
-                        asyncio.create_task(self._on_node_dead(node_id, "daemon disconnected"))
+                        self._spawn_bg(self._on_node_dead(node_id, "daemon disconnected"), name="on-node-dead")
                 elif role == "driver":
-                    asyncio.create_task(self._on_driver_exit(c.meta.get("job_id")))
+                    self._spawn_bg(self._on_driver_exit(c.meta.get("job_id")), name="on-driver-exit")
             except RuntimeError:
                 pass  # loop already shutting down
 
@@ -480,7 +493,7 @@ class Controller:
         if node is not None:
             node.draining = False
             # Reopened capacity: demand that pended against the drain runs now.
-            asyncio.create_task(self._retry_pending())
+            self._spawn_bg(self._retry_pending(), name="retry-pending")
         return {"ok": node is not None}
 
     def handle_heartbeat(self, conn, p):
@@ -1006,7 +1019,7 @@ class Controller:
         for pg in self.pgs.values():
             if pg.state == "CREATED" and any(b.node_id == node_id for b in pg.bundles):
                 pg.state = "RESCHEDULING"
-                asyncio.create_task(self._schedule_pg(pg))
+                self._spawn_bg(self._schedule_pg(pg), name="reschedule-pg")
 
     async def _on_driver_exit(self, job_id):
         if job_id is None:
@@ -1191,7 +1204,7 @@ class Controller:
         if entry:
             node_id, demand, strategy, _owner = entry
             self._restore(node_id, demand, p.get("strategy", strategy))
-            asyncio.create_task(self._retry_pending())
+            self._spawn_bg(self._retry_pending(), name="retry-pending")
         return True
 
     def _release_leases_of(self, conn):
@@ -1207,7 +1220,7 @@ class Controller:
             if getattr(pl, "conn", None) is conn:
                 self.pending_leases.remove(pl)
         if released:
-            asyncio.create_task(self._retry_pending())
+            self._spawn_bg(self._retry_pending(), name="retry-pending")
 
     async def _retry_pending(self):
         """Event-driven reconciliation of ALL pending work (leases, PGs,
@@ -1244,7 +1257,7 @@ class Controller:
                     # task, or the same free capacity double-books across
                     # actors/leases examined later in this pass.
                     self._consume(node, spec.options.resource_demand(), spec.options.scheduling_strategy)
-                    asyncio.create_task(self._start_actor_on(record, node))
+                    self._spawn_bg(self._start_actor_on(record, node), name="start-actor")
                     progress = True
 
     # -- actors ---------------------------------------------------------
@@ -1265,7 +1278,7 @@ class Controller:
         # Creation is asynchronous: the handle is usable immediately and the
         # first method call blocks on wait_actor_alive (reference:
         # GcsActorManager registration is async from the caller's view).
-        asyncio.create_task(self._schedule_actor(record))
+        self._spawn_bg(self._schedule_actor(record), name="schedule-actor")
         return self._actor_info(record)
 
     async def _actor_info_when_alive(self, record: ActorRecord):

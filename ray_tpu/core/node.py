@@ -101,14 +101,14 @@ class NodeDaemon:
         self.log_dir = os.path.join(self.session_dir, "logs", self.node_id[:12])
         self._log_monitor = None
 
-    def _spawn_bg(self, coro) -> asyncio.Task:
+    def _spawn_bg(self, coro, name: str | None = None) -> asyncio.Task:
         """create_task with a strong reference held until completion. Every
         fire-and-forget task in this daemon must go through here: asyncio
         keeps only weak refs, and a gc cycle landing mid-await kills an
         unreferenced task with GeneratorExit (observed as lost sealed-object
         reports and never-reported worker deaths)."""
         loop = self._loop if self._loop is not None else asyncio.get_running_loop()
-        return _spawn_bg_task(self._misc_tasks, coro, loop=loop)
+        return _spawn_bg_task(self._misc_tasks, coro, loop=loop, name=name)
 
     # ------------------------------------------------------------------
     async def start(self, port: int = 0) -> str:
@@ -936,8 +936,12 @@ class PullManager:
                     finally:
                         self._release_bytes(ln)
 
+            # Through the daemon's strong-ref registry for uniformity with
+            # every other spawn (the local `workers` list + gather below
+            # already pin these, but one spawn idiom keeps graftlint's
+            # bg-strong-ref story simple and names the tasks for leak debug).
             workers = [
-                asyncio.ensure_future(window_worker())
+                d._spawn_bg(window_worker(), name="pull-window")
                 for _ in range(min(max(1, cfg.pull_window_chunks), nchunks))
             ]
             results = await asyncio.gather(*workers, return_exceptions=True)

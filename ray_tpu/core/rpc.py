@@ -612,7 +612,7 @@ class Connection:
                 mv = memoryview(buf)[:take]
                 dest[got : got + take] = mv
                 mv.release()
-                del buf[:take]
+                del buf[:take]  # graftlint: disable=counted-trims  consuming received bytes into dest, not discarding data
                 if hasher is not None:
                     hasher.update(dest[got : got + take])
                 got += take

@@ -682,11 +682,11 @@ class CoreWorker:
                 except Exception:  # isolate: one bad post must not drop the rest
                     logger.exception("posted submission callback failed")
 
-    def _spawn_bg(self, coro) -> "asyncio.Task":
+    def _spawn_bg(self, coro, name: str | None = None) -> "asyncio.Task":
         """create_task with a strong reference held until completion (see
         _bg_tasks: an unreferenced fire-and-forget task can be GC-killed
         mid-await). Must be called from the IO loop."""
-        return _spawn_bg_task(self._bg_tasks, coro)
+        return _spawn_bg_task(self._bg_tasks, coro, name=name)
 
     def _run(self, coro, timeout=None):
         """Run a coroutine on the IO loop from a sync context."""
@@ -1218,11 +1218,17 @@ class CoreWorker:
             restored = self.store.restore(oid, evicted_out=evicted)
             if evicted:
                 try:
-                    asyncio.get_running_loop().create_task(self._report_evicted(evicted))
+                    loop = asyncio.get_running_loop()
                 except RuntimeError:
-                    # Caller-thread fast path: report via the IO loop.
-                    if self.loop is not None:
-                        asyncio.run_coroutine_threadsafe(self._report_evicted(evicted), self.loop)
+                    loop = None
+                if loop is self.loop:
+                    _spawn_bg_task(self._bg_tasks, self._report_evicted(evicted), loop=loop)
+                elif self.loop is not None:
+                    # Caller-thread path — including a DIFFERENT running
+                    # loop (user code driving its own asyncio loop calls a
+                    # sync get): the report must run on the worker IO loop,
+                    # where the controller connection lives.
+                    asyncio.run_coroutine_threadsafe(self._report_evicted(evicted), self.loop)
             if restored:
                 buf = self.store.get_pinned(oid)
             else:
@@ -1452,7 +1458,7 @@ class CoreWorker:
                 # FSM: the attempt exists but its args aren't resolved yet;
                 # _enqueue_submit advances it to PENDING_NODE_ASSIGNMENT.
                 self._task_event("task_pending_args", spec)
-                asyncio.ensure_future(self._submit(spec, dep_refs))
+                self._spawn_bg(self._submit(spec, dep_refs))
             else:
                 self._enqueue_submit(spec)
 
@@ -1581,7 +1587,7 @@ class CoreWorker:
             def ack(consumed: int, conn=conn, tb=p["task_id"]):
                 def go():
                     if not conn.closed:
-                        asyncio.ensure_future(
+                        self._spawn_bg(
                             conn.notify("generator_ack", {"task_id": tb, "consumed": consumed})
                         )
 
@@ -1768,7 +1774,7 @@ class CoreWorker:
         def go():
             conn = self._stream_conns.get(task_id_bytes)
             if conn is not None and not conn.closed:
-                asyncio.ensure_future(
+                self._spawn_bg(
                     conn.notify("generator_close", {"task_id": task_id_bytes})
                 )
 
@@ -2097,7 +2103,7 @@ class CoreWorker:
         if not exc:
             self._absorb_task_reply(spec, fut.result())
             return
-        asyncio.ensure_future(self._actor_reply_failed(spec, fut, entry))
+        self._spawn_bg(self._actor_reply_failed(spec, fut, entry))
 
     async def _actor_reply_failed(self, spec: TaskSpec, fut, entry):
         try:

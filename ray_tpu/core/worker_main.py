@@ -46,7 +46,7 @@ def main():
 
     # Strong reference: an unreferenced init task can be GC'd mid-await
     # (same latent footgun as CoreWorker.start_driver_sync's init task).
-    init_task = loop.create_task(init())
+    init_task = loop.create_task(init())  # graftlint: disable=bg-strong-ref  run_forever below keeps this frame (and the ref) alive for the process lifetime
     try:
         loop.run_forever()
     finally:

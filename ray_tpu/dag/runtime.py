@@ -97,7 +97,10 @@ async def dag_push(core, conn, p):
     if len(slot_map) < st.spec["n_inputs"]:
         return True
     del st.pending[seq]
-    asyncio.create_task(_run_stage(core, st.spec, seq, slot_map, st.trace.pop(seq, None)))
+    # Strong ref until the stage completes: a GC cycle mid-await would kill
+    # an unreferenced stage task — its seq never emits downstream and the
+    # whole DAG run wedges (bg-strong-ref; core's registry holds it).
+    core._spawn_bg(_run_stage(core, st.spec, seq, slot_map, st.trace.pop(seq, None)))
     return True
 
 

@@ -92,6 +92,13 @@ class _ReplicaSet:
         self._ongoing_gauge = _metrics.Gauge(
             "serve.handle.ongoing", "requests in flight to replicas from this handle",
             tag_keys=("app", "deployment")).set_default_tags(tags)
+        # No silent caps (graftlint counted-trims): an LRU-evicted affinity
+        # pin costs a model reload on the next request for that key, so the
+        # eviction rate is an operator signal, not an internal detail.
+        self._affinity_evicted = _metrics.Counter(
+            "serve.handle.affinity_evicted",
+            "sticky model->replica pins dropped by the AFFINITY_CAP LRU bound",
+            tag_keys=("app", "deployment")).set_default_tags(tags)
 
     # -- membership --------------------------------------------------------
     def _maybe_refresh(self):
@@ -267,6 +274,7 @@ class _ReplicaSet:
             self.model_affinity[affinity] = pick
             while len(self.model_affinity) > self.AFFINITY_CAP:  # LRU bound
                 self.model_affinity.pop(next(iter(self.model_affinity)))
+                self._affinity_evicted.inc()
         return pick
 
     def fail_over(self, name: str):

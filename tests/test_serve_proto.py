@@ -115,6 +115,9 @@ def test_pickle_path_still_works_alongside(proto_app):
     s.close()
     body = raw[4:4 + n]
     if _rpc.get_auth_token():
-        body = body[_rpc.FRAME_TAG_LEN:]
+        tag, body = body[:_rpc.FRAME_TAG_LEN], body[_rpc.FRAME_TAG_LEN:]
+        # Verify the reply MAC, not just strip it — the client-side half of
+        # the contract the proxy enforces on ingress.
+        assert _rpc.frame_verify(tag, body)
     status, result = pickle.loads(body)
     assert (status, result) == ("ok", 3)

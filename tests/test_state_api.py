@@ -4,7 +4,6 @@ bounded per-task index (filters, truncation, eviction accounting), the
 real cluster — RUNNING attribution and the `ray memory` equivalent's
 owner/borrower round trip. Mirrors the reference's state-API tests
 (python/ray/tests/test_state_api.py) at this controller's layer."""
-import ast
 import time
 
 import pytest
@@ -34,39 +33,20 @@ def test_fsm_tables_consistent():
     assert reachable | {ts.PENDING_ARGS_AVAIL, ts.PENDING_NODE_ASSIGNMENT} == set(ts.STATES)
 
 
-def _event_kinds_in(path: str, fn_names=("_event", "_task_event")) -> set:
-    """Every literal kind passed to self._event / self._task_event in a
-    source file (lint-style: a new emitter with an unmapped kind fails)."""
-    with open(path) as f:
-        tree = ast.parse(f.read())
-    kinds = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if isinstance(fn, ast.Attribute) and fn.attr in fn_names and node.args:
-            arg = node.args[0]
-            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                kinds.add(arg.value)
-    return kinds
-
-
 def test_every_worker_event_kind_maps_to_fsm():
-    """Lint: every event kind worker.py emits is either a lifecycle kind
-    with a legal FSM mapping or an explicitly declared non-lifecycle kind.
-    An unknown kind means someone added an emitter without deciding what it
-    does to the state index."""
+    """Thin wrapper over graftlint's fsm-emitter rule (the ad-hoc AST scan
+    that used to live here migrated into ray_tpu/analysis/rules_fsm.py).
+    Asserts the rule still SEES emitters — a scan that finds zero emitters
+    has silently gone dead and gates nothing — and that worker.py's kinds
+    all map into the FSM."""
     import ray_tpu.core.worker as worker_mod
+    from ray_tpu.analysis import lint_paths
 
-    kinds = _event_kinds_in(worker_mod.__file__)
-    assert kinds, "lint found no emitters — the scan is broken"
-    known = set(ts.EVENT_STATE) | set(ts.NON_LIFECYCLE_KINDS)
-    unknown = kinds - known
-    assert not unknown, f"worker.py emits unmapped event kinds: {sorted(unknown)}"
-    # And the lifecycle kinds it emits cover the whole FSM.
-    emitted_states = {ts.EVENT_STATE[k] for k in kinds if ts.EVENT_STATE.get(k)}
-    assert emitted_states >= set(ts.STATES) - {ts.FAILED} , emitted_states
-    assert "task_failed" in kinds or "task_finished" in kinds  # FAILED emitters
+    result = lint_paths([worker_mod.__file__])
+    stats = result.stats.get(worker_mod.__file__, {}).get("fsm-emitter")
+    assert stats and stats["emitters"] >= 1, "fsm-emitter scan found no emitters — the scan is broken"
+    fsm_findings = [f for f in result.findings if f.rule == "fsm-emitter"]
+    assert not fsm_findings, "\n".join(f.render() for f in fsm_findings)
 
 
 def test_fold_converges_regardless_of_arrival_order():
