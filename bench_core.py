@@ -94,7 +94,23 @@ def noop():
     return b"ok"
 
 
+def _chaos_armed_noop():
+    """Arm a schedule whose single rule can never match the RPC/exec hot
+    path: every gate pass now runs the full enabled-path evaluation (fnmatch
+    against the rule set) — the upper bound of what an armed-but-quiet
+    chaos plane costs. The headline rows run with the plane OFF (plan=None:
+    one attribute load + None check per gate), so disabled-path cost shows
+    up only as this round's headline vs the previous round's."""
+    from ray_tpu import chaos
+
+    chaos.install(chaos.FaultSchedule.from_spec({
+        "seed": 0,
+        "rules": [{"site": "tpu.preempt", "kind": "preempt", "nth": 1 << 30}],
+    }))
+
+
 def bench_actor_sync(n):
+    from ray_tpu import chaos
     from ray_tpu.util import tracing
 
     a = Sink.remote()
@@ -114,16 +130,26 @@ def bench_actor_sync(n):
 
     elapsed = timed(run, n)
     traced = timed(run_traced, n)
-    off_ops, on_ops = n / elapsed, n / traced
+    _chaos_armed_noop()
+    try:
+        armed = timed(run, n)
+    finally:
+        chaos.uninstall()
+    off_ops, on_ops, armed_ops = n / elapsed, n / traced, n / armed
     # The headline row stays tracing-OFF (comparable across rounds); the
-    # on/off A/B rides in detail so BENCH_CORE.json tracks observability
-    # cost (ISSUE 2: overhead reported, not hidden).
+    # on/off A/Bs ride in detail so BENCH_CORE.json tracks observability
+    # and chaos-plane cost (overhead reported, not hidden).
     report("1_1_actor_calls_sync", n, elapsed, detail={
         "trace_overhead": {
             "off_ops_s": round(off_ops, 1),
             "on_ops_s": round(on_ops, 1),
             "overhead_pct": round((off_ops / on_ops - 1.0) * 100.0, 2),
-        }
+        },
+        "chaos_overhead": {
+            "off_ops_s": round(off_ops, 1),
+            "armed_noop_ops_s": round(armed_ops, 1),
+            "overhead_pct": round((off_ops / armed_ops - 1.0) * 100.0, 2),
+        },
     })
 
 
@@ -137,6 +163,7 @@ def _wire_batch_hist():
 
 
 def bench_actor_async(n):
+    from ray_tpu import chaos
     from ray_tpu.core import rpc
 
     a = Sink.remote()
@@ -146,8 +173,21 @@ def bench_actor_async(n):
         rpc.batch_stats(reset=True)
         rt.get([a.ping.remote() for _ in range(k)], timeout=120)
 
-    report("1_1_actor_calls_async", n, timed(run, n),
-           detail={"wire_batches": _wire_batch_hist()})
+    elapsed = timed(run, n)
+    _chaos_armed_noop()
+    try:
+        armed = timed(run, n)
+    finally:
+        chaos.uninstall()
+    report("1_1_actor_calls_async", n, elapsed,
+           detail={
+               "wire_batches": _wire_batch_hist(),
+               "chaos_overhead": {
+                   "off_ops_s": round(n / elapsed, 1),
+                   "armed_noop_ops_s": round(n / armed, 1),
+                   "overhead_pct": round((armed / elapsed - 1.0) * 100.0, 2),
+               },
+           })
 
 
 def bench_actor_nn_async(n):

@@ -129,6 +129,9 @@ def main(argv=None):
     scripts.add_stop_parser(sub)
     scripts.add_state_parsers(sub)  # list | summary | memory | status | logs
     add_lint_parser(sub)  # pure source-tree pass; never connects
+    from ray_tpu.chaos import add_chaos_parser, cmd_chaos
+
+    add_chaos_parser(sub)  # seeded fault-injection scenario runner
     ep = sub.add_parser("events")
     ep.add_argument("--limit", type=int, default=100)
     sub.add_parser("metrics")
@@ -155,6 +158,8 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.cmd == "lint":
         sys.exit(cmd_lint(args))
+    if args.cmd == "chaos":
+        sys.exit(cmd_chaos(args))
     if args.cmd == "start":
         sys.exit(scripts.cmd_start(args))
     if args.cmd == "stop":

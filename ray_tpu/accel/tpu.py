@@ -104,6 +104,26 @@ def set_visible_chips(chip_ids: list[int] | list[str], env: dict | None = None):
     target["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,1,{len(chip_ids)}" if chip_ids else ""
 
 
+def preemption_notice(node_id: str, labels: Optional[dict] = None):
+    """Consult the chaos plane for an injected TPU-preemption notice for
+    this host (reference: GCE preempts TPU VMs with a short notice; the
+    reference's chaos suites simulate it by killing raylets on a timer —
+    here it is a seeded, replayable schedule decision). Called once per
+    daemon heartbeat; returns the Fault (its ``delay_s`` is the grace
+    window) or None. Real-metadata-server detection would slot in here
+    alongside the injected path.
+    """
+    from ray_tpu import chaos
+
+    labels = labels or {}
+    return chaos.maybe_inject(
+        "tpu.preempt",
+        node=node_id[:12],
+        worker_id=labels.get(TPU_WORKER_ID_LABEL, ""),
+        slice=labels.get(TPU_SLICE_NAME_LABEL, ""),
+    )
+
+
 class TPUAcceleratorManager:
     """Accelerator manager ABC-equivalent (reference: accelerators/accelerator.py)."""
 

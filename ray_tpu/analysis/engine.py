@@ -211,6 +211,7 @@ def default_rules() -> list:
         NoBlockingInAsync,
     )
     from ray_tpu.analysis.rules_buffers import CountedTrims
+    from ray_tpu.analysis.rules_chaos import ChaosGate
     from ray_tpu.analysis.rules_fsm import FsmEmitter
     from ray_tpu.analysis.rules_security import MacBeforePickle
 
@@ -221,6 +222,7 @@ def default_rules() -> list:
         CountedTrims(),
         LoopThreadRace(),
         FsmEmitter(),
+        ChaosGate(),
     ]
 
 

@@ -106,6 +106,21 @@ class Autoscaler:
     def update(self) -> dict:
         """Returns {"launched": {type: n}, "terminated": [provider_ids]}."""
         state = self._cluster_state()
+        # Dead instances first (TPU preemption, host loss): a provider record
+        # whose controller node is DEAD will never serve again, but it still
+        # counts against max_workers — release the slot so the replacement
+        # for the preempted slice host can actually launch this update.
+        pruned: list[str] = []
+        for pid in list(self.provider.non_terminated_nodes()):
+            nid = self.provider.controller_node_id(pid, state["nodes"])
+            if nid is not None and state["nodes"].get(nid, {}).get("state") == "DEAD":
+                try:
+                    self.provider.terminate_node(pid)
+                except Exception:
+                    pass  # a half-dead instance may refuse teardown; the slot is freed either way
+                pruned.append(pid)
+                self._idle_since.pop(pid, None)
+                self._draining.pop(pid, None)
         # Free capacity on live nodes absorbs some pending demand first.
         # Each entry carries the node's labels: label-selected demand only
         # fits nodes the scheduler would actually match.
@@ -231,7 +246,7 @@ class Autoscaler:
                 if nid_draining is not None:
                     # Work appeared while draining: reopen the node.
                     self._call_controller("undrain_node", {"node_id": nid_draining})
-        return {"launched": launched, "terminated": terminated, "unmet": len(unmet),
+        return {"launched": launched, "terminated": pruned + terminated, "unmet": len(unmet),
                 "draining": list(self._draining)}
 
     def _call_controller(self, method: str, payload: dict) -> dict:

@@ -1,0 +1,443 @@
+"""Invariant-checked chaos scenarios: start a cluster, arm a seeded fault
+schedule, drive a workload, assert the cluster converged clean.
+
+``python -m ray_tpu chaos run <scenario> [--seed N]`` runs one scenario in
+an in-process cluster (this command never connects to a live cluster — a
+chaos run is a destructive experiment, not an operator query) and prints a
+JSON report. Re-running with the same seed replays the same per-rule
+injection sequence (see plan.py); the report embeds the normalized
+injection log so a failure is replayable from its own output.
+
+Reference analogue: the nightly ``chaos_test`` suites (kill raylets/workers
+on a schedule, assert the workload completes) — with wall-clock killers
+replaced by seeded nth-hit schedules and the pass condition widened from
+"workload finished" to the cluster invariants in invariants.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Callable, Optional
+
+from ray_tpu.chaos import plan as _plan
+from ray_tpu.chaos import invariants as _inv
+
+
+class ScenarioFailure(AssertionError):
+    pass
+
+
+# The scenario's in-process Cluster, registered at creation so the runner's
+# finally can tear it down even when the scenario raises mid-build (an
+# address-connected driver's shutdown() does NOT stop the cluster it dialed).
+_ACTIVE: dict = {"cluster": None}
+
+
+def _register_cluster(cluster):
+    _ACTIVE["cluster"] = cluster
+    return cluster
+
+
+def _require(cond: bool, why: str):
+    if not cond:
+        raise ScenarioFailure(why)
+
+
+def _fresh_config():
+    from ray_tpu.core.config import Config
+
+    cfg = Config().apply_env()
+    # Scenario clusters are short-lived: tight reporter/flush ticks so the
+    # metrics/state invariants observe injections without long waits.
+    cfg.metrics_report_interval_s = 0.5
+    return cfg
+
+
+def _teardown():
+    from ray_tpu.core import api
+
+    try:
+        api.shutdown()
+    finally:
+        cluster, _ACTIVE["cluster"] = _ACTIVE["cluster"], None
+        try:
+            if cluster is not None:
+                cluster.shutdown()
+        finally:
+            _plan.uninstall()
+
+
+def _drain_retries(refs, timeout: float):
+    import ray_tpu as rt
+
+    return [rt.get(r, timeout=timeout) for r in refs]
+
+
+# ---------------------------------------------------------------------------
+# Scenarios. Each returns {"details": ..., "min_injections": int,
+# "min_metric_injections": int | None} and leaves the driver connected for
+# the invariant battery; the runner handles teardown.
+# ---------------------------------------------------------------------------
+
+
+def _scn_worker_kill(seed: int, quick: bool) -> dict:
+    """Kill a worker mid-task on its Nth execution (hard os._exit, the
+    SIGKILL shape): retriable tasks must all complete on replacement
+    workers. The tier-1 smoke scenario — CPU-only, single node."""
+    import ray_tpu as rt
+    from ray_tpu.core.api import Cluster, init
+
+    cfg = _fresh_config()
+    cfg.chaos_spec = json.dumps({
+        "seed": seed,
+        "rules": [{"site": "worker.exec", "kind": "kill", "nth": 3}],
+    })
+    _plan.install_from_json(cfg.chaos_spec)
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=2)
+    init(address=cluster.address, config=cfg)
+    n = 4 if quick else 8
+
+    @rt.remote(max_retries=5)
+    def work(i):
+        time.sleep(0.02)
+        return i * 2
+
+    # Waves of (worker-pool size): dispatches stay singletons, so a killed
+    # worker loses ONE task, not a whole batch — with every fresh worker
+    # also dying on ITS 3rd exec, a lost >=3-task batch would re-lose a
+    # member on every retry by construction (correlated-failure artifact of
+    # the deterministic schedule, not a recovery bug).
+    got = []
+    for base in range(0, n, 2):
+        refs = [work.remote(i) for i in range(base, min(base + 2, n))]
+        got.extend(_drain_retries(refs, timeout=180))
+    _require(got == [i * 2 for i in range(n)], f"wrong results: {got}")
+    # Evidence the kill really happened: at least one attempt was retried
+    # (the killed worker's task re-ran as attempt >= 1). The injecting
+    # process died with its own fault, so the metric counter legitimately
+    # reads zero — the retry IS the observable.
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    core._run(core._flush_task_events())
+    out = core._run(core.controller.call("list_tasks", {"fn": "work", "limit": 200}))
+    retried = [t for t in out.get("tasks", []) if t.get("attempt", 0) > 0]
+    _require(bool(retried), "no retried attempt in the task index — the kill never landed")
+    return {
+        "cluster": cluster,
+        "details": {"tasks": n, "retried_attempts": len(retried)},
+        "min_injections": 0,
+        "min_metric_injections": 0,
+    }
+
+
+def _scn_pull_source_death(seed: int, quick: bool) -> dict:
+    """A pull source fails mid-object (chunk fetch + chunk serve faults):
+    the windowed pull must fail over to the alternate replica and deliver a
+    value-correct object."""
+    import numpy as np
+    import ray_tpu as rt
+    from ray_tpu.core.api import Cluster, init
+
+    cfg = _fresh_config()
+    cfg.pull_chunk_size = 1024 * 1024  # multi-chunk objects at test sizes
+    cfg.chaos_spec = json.dumps({
+        "seed": seed,
+        "rules": [
+            {"site": "node.pull.source", "kind": "error", "nth": 2},
+            {"site": "node.chunk.serve", "kind": "error", "nth": 5},
+        ],
+    })
+    _plan.install_from_json(cfg.chaos_spec)
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=2)  # head/driver node
+    cluster.add_node(num_cpus=2, resources={"srcA": 2.0})
+    cluster.add_node(num_cpus=2, resources={"srcB": 2.0})
+    init(address=cluster.address, config=cfg)
+    mb = 4 if quick else 6
+
+    @rt.remote(resources={"srcA": 1.0}, max_retries=2)
+    def make():
+        return np.arange((mb << 20) // 8, dtype=np.int64)
+
+    @rt.remote(resources={"srcB": 1.0}, max_retries=2)
+    def replicate(arr):
+        return int(arr[-1])  # pulling onto srcB leaves a second replica there
+
+    ref = make.remote()
+    last = rt.get(replicate.remote(ref), timeout=180)
+    _require(last == (mb << 20) // 8 - 1, f"replicate saw wrong tail {last}")
+    got = rt.get(ref, timeout=180)  # head pulls, striped across both replicas
+    _require(int(got[0]) == 0 and int(got[-1]) == last and got.shape == ((mb << 20) // 8,),
+             "pulled object is not value-correct")
+    retried = sum(d.pull_manager.chunks_retried for d in cluster.daemons)
+    _require(retried >= 1, "no chunk ever retried — the faults never bit a transfer")
+    del got
+    return {
+        "cluster": cluster,
+        "details": {"object_mb": mb, "chunks_retried": retried},
+        "min_injections": 1,
+        "min_metric_injections": 1,
+    }
+
+
+def _scn_controller_restart(seed: int, quick: bool) -> dict:
+    """Controller crash + restart while submissions are live: in-flight
+    lease requests fail over the reconnect, every task still completes, and
+    the restored control plane's task index ends all-terminal."""
+    import ray_tpu as rt
+    from ray_tpu.core.api import Cluster, init
+
+    cfg = _fresh_config()
+    cfg.chaos_spec = json.dumps({
+        "seed": seed,
+        "rules": [
+            {"site": "controller.lease.grant", "kind": "delay",
+             "every": 2, "delay_s": 0.05},
+        ],
+    })
+    _plan.install_from_json(cfg.chaos_spec)
+    snap = os.path.join(tempfile.mkdtemp(prefix="raytpu_chaos_"), "controller.snap")
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg, persist_path=snap))
+    cluster.add_node(num_cpus=2)
+    init(address=cluster.address, config=cfg)
+    n = 6 if quick else 10
+
+    @rt.remote(max_retries=5)
+    def work(i):
+        time.sleep(0.05)
+        return i + 100
+
+    wave1 = [work.remote(i) for i in range(n)]
+    got1 = _drain_retries(wave1, timeout=180)
+    time.sleep(1.2)  # snapshot tick persists registrations
+    # Live submissions straddling the restart: fire wave2, kill the
+    # controller before collecting anything.
+    wave2 = [work.remote(i) for i in range(n)]
+    cluster.restart_controller()
+    wave3 = [work.remote(i) for i in range(n)]
+    got2 = _drain_retries(wave2, timeout=240)
+    got3 = _drain_retries(wave3, timeout=240)
+    expect = [i + 100 for i in range(n)]
+    _require(got1 == expect and got2 == expect and got3 == expect,
+             "lost or wrong results across the controller restart")
+    return {
+        "cluster": cluster,
+        "details": {"waves": 3, "tasks_per_wave": n},
+        "min_injections": 1,
+        "min_metric_injections": 1,
+    }
+
+
+def _scn_mac_corrupt_storm(seed: int, quick: bool) -> dict:
+    """Storm of MAC-corrupted frames on the session's live connections: each
+    corrupted frame makes the receiving peer drop the connection (fail-loud
+    auth contract); retries + persistent redial must carry every task to a
+    correct result. Armed AFTER init so cluster bring-up itself is clean —
+    the storm tests the steady-state recovery paths."""
+    import ray_tpu as rt
+    from ray_tpu.core.api import Cluster, init
+
+    cfg = _fresh_config()
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=2)
+    init(address=cluster.address, config=cfg)
+    _require(bool(cfg.auth_token), "storm scenario needs the authed wire (auto-mint is on by default)")
+    storm = 3 if quick else 6
+    _plan.install(_plan.FaultSchedule.from_spec({
+        "seed": seed,
+        # Frame coalescing makes envelopes scarce (one per burst, not per
+        # call): a short cadence is needed for a storm of useful size.
+        "rules": [{"site": "rpc.frame.send", "kind": "corrupt_mac",
+                   "every": 5, "max_faults": storm}],
+    }))
+    n = 8 if quick else 12
+
+    @rt.remote(max_retries=8)
+    def work(i):
+        return i * 3
+
+    results = []
+    for _wave in range(3):
+        refs = [work.remote(i) for i in range(n)]
+        results.append(_drain_retries(refs, timeout=240))
+    injected = len(_plan.injection_log())
+    _plan.uninstall()  # storm over; the invariant battery runs on a clean wire
+    expect = [i * 3 for i in range(n)]
+    _require(all(r == expect for r in results), f"storm corrupted results: {results}")
+    # One clean wave after the storm: the session fully recovered.
+    refs = [work.remote(i) for i in range(n)]
+    _require(_drain_retries(refs, timeout=180) == expect, "post-storm wave failed")
+    _require(injected >= storm, f"storm under-fired: {injected} < {storm}")
+    return {
+        "cluster": cluster,
+        "details": {"frames_corrupted": injected, "waves": 4},
+        "min_injections": storm,
+        "min_metric_injections": storm,
+    }
+
+
+def _scn_tpu_preempt_drain(seed: int, quick: bool) -> dict:
+    """Injected TPU-preemption notice on one slice host: the node drains,
+    then drops off the cluster after its grace window; the actor living
+    there restarts once the autoscaler replaces the preempted host."""
+    import ray_tpu as rt
+    from ray_tpu.accel.tpu import TPU_SLICE_NAME_LABEL, TPU_WORKER_ID_LABEL
+    from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider, NodeType
+    from ray_tpu.core.api import Cluster, init
+
+    cfg = _fresh_config()
+    cfg.heartbeat_interval_s = 0.2
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=2)  # head/driver node, no TPUs
+    victim = cluster.add_node(
+        num_cpus=2, resources={"TPU": 4.0},
+        labels={TPU_SLICE_NAME_LABEL: "slice-a", TPU_WORKER_ID_LABEL: "1"},
+    )
+    init(address=cluster.address, config=cfg)
+
+    @rt.remote(resources={"TPU": 1.0}, max_restarts=3, max_task_retries=3)
+    class Replica:
+        def pid(self):
+            return os.getpid()
+
+    a = Replica.remote()
+    pid1 = rt.get(a.pid.remote(), timeout=120)
+    provider = LocalNodeProvider(cluster)
+    scaler = Autoscaler(
+        [NodeType("tpu-host", {"TPU": 4.0},
+                  labels={TPU_SLICE_NAME_LABEL: "slice-b", TPU_WORKER_ID_LABEL: "1"})],
+        provider, idle_timeout_s=3600.0,
+    )
+    # Arm AFTER the actor is placed: the preemption notice must strike a
+    # host that is actually running gang work. In-process daemons consult
+    # the shared plan immediately; nth=1 = the victim's next heartbeat.
+    _plan.install(_plan.FaultSchedule.from_spec({
+        "seed": seed,
+        "rules": [{"site": "tpu.preempt", "kind": "preempt", "nth": 1,
+                   "delay_s": 0.3, "ctx": {"worker_id": "1", "slice": "slice-a"}}],
+    }))
+    deadline = time.monotonic() + 60
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    while time.monotonic() < deadline:
+        nodes = core._run(core.controller.call("get_cluster_state", {}))["nodes"]
+        if nodes.get(victim.node_id, {}).get("state") == "DEAD":
+            break
+        time.sleep(0.2)
+    else:
+        raise ScenarioFailure("preempted node never died")
+    # Replacement capacity: the autoscaler sees the pending (restarting)
+    # actor's demand and launches a fresh slice host.
+    pid2 = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        scaler.update()
+        try:
+            pid2 = rt.get(a.pid.remote(), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.3)
+    _require(pid2 is not None and pid2 != pid1,
+             f"actor never restarted on a replacement host (pid1={pid1}, pid2={pid2})")
+    drained = any(e.get("kind") == "node_draining"
+                  for e in core._run(core.controller.call("get_events", {"limit": 500})))
+    _require(drained, "no drain event recorded before the preemption death")
+    return {
+        "cluster": cluster,
+        "details": {"pid_before": pid1, "pid_after": pid2},
+        "min_injections": 1,
+        "min_metric_injections": 1,
+    }
+
+
+SCENARIOS: dict = {
+    "worker_kill": _scn_worker_kill,
+    "pull_source_death": _scn_pull_source_death,
+    "controller_restart": _scn_controller_restart,
+    "mac_corrupt_storm": _scn_mac_corrupt_storm,
+    "tpu_preempt_drain": _scn_tpu_preempt_drain,
+}
+
+
+def run_scenario(name: str, seed: int = 0, quick: bool = False) -> dict:
+    """Run one scenario end to end. Returns the report dict; report["ok"]
+    is the pass verdict (workload asserts AND the invariant battery)."""
+    fn: Optional[Callable] = SCENARIOS.get(name)
+    if fn is None:
+        raise ValueError(f"unknown scenario {name!r} (have: {sorted(SCENARIOS)})")
+    from ray_tpu.core import api
+
+    if api.is_initialized():
+        raise RuntimeError("chaos scenarios need a fresh process-level session "
+                           "(ray_tpu is already initialized)")
+    t0 = time.monotonic()
+    report: dict = {"scenario": name, "seed": seed, "ok": False}
+    try:
+        out = fn(seed, quick)
+        cluster = out.pop("cluster")
+        core = api._require_worker()
+        inv = _inv.check_all(
+            core, cluster,
+            min_injections=out.get("min_injections", 1),
+            min_metric_injections=out.get("min_metric_injections"),
+        )
+        report["details"] = out.get("details", {})
+        report["invariants"] = inv
+        report["injections"] = _plan.injection_log(normalize=True)
+        report["ok"] = inv["ok"]
+    except ScenarioFailure as e:
+        report["error"] = str(e)
+        report["injections"] = _plan.injection_log(normalize=True)
+    except Exception as e:  # noqa: BLE001 - a lost task surfaces as GetTimeoutError etc.
+        # The MOST interesting chaos outcome is an unexpected exception (a
+        # get timeout IS the lost-task symptom this plane hunts): it must
+        # land in the report with the injection log — the replay recipe —
+        # not escape as a raw traceback that aborts the rest of the battery.
+        report["error"] = f"{type(e).__name__}: {e}"
+        report["injections"] = _plan.injection_log(normalize=True)
+    finally:
+        report["elapsed_s"] = round(time.monotonic() - t0, 2)
+        _teardown()
+    return report
+
+
+# ---------------------------------------------------------------------------
+# CLI (python -m ray_tpu chaos ...)
+# ---------------------------------------------------------------------------
+
+
+def add_chaos_parser(sub) -> None:
+    cp = sub.add_parser("chaos", help="seeded fault-injection scenario runner")
+    csub = cp.add_subparsers(dest="chaos_cmd", required=True)
+    crun = csub.add_parser("run", help="run one scenario in a fresh in-process cluster")
+    crun.add_argument("scenario", choices=sorted(SCENARIOS) + ["all"])
+    crun.add_argument("--seed", type=int, default=0)
+    crun.add_argument("--quick", action="store_true", help="smaller workloads")
+    csub.add_parser("list", help="scenarios + the fault-site catalog")
+
+
+def cmd_chaos(args) -> int:
+    if args.chaos_cmd == "list":
+        from ray_tpu.chaos.sites import catalog
+
+        print("scenarios:")
+        for name in sorted(SCENARIOS):
+            print(f"  {name:22s} {(SCENARIOS[name].__doc__ or '').strip().splitlines()[0]}")
+        print("\nfault sites (schedule rules name these):")
+        for row in catalog():
+            print(f"  {row['site']:24s} [{row['layer']}] kinds={','.join(row['kinds'])}")
+            print(f"  {'':24s} {row['desc']}")
+        return 0
+    names = sorted(SCENARIOS) if args.scenario == "all" else [args.scenario]
+    failed = 0
+    for name in names:
+        report = run_scenario(name, seed=args.seed, quick=args.quick)
+        print(json.dumps(report, default=str))
+        if not report["ok"]:
+            failed += 1
+    return 1 if failed else 0

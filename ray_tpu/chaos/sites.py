@@ -1,0 +1,117 @@
+"""The chaos site catalog: every gate woven into the tree, what faults it
+supports, and what recovery machinery the fault exercises.
+
+Schedules are validated against this catalog (FaultSchedule.validate): a
+concrete site pattern must name a row here and use one of its kinds, so a
+typo'd schedule fails loud instead of injecting nothing. Site-name
+uniqueness and the one-gate idiom are machine-enforced by graftlint rule
+``chaos-gate``.
+"""
+from __future__ import annotations
+
+# site name -> {layer, kinds, desc, exercises}
+SITES: dict = {
+    # -- L0: rpc transport ----------------------------------------------
+    "rpc.frame.send": {
+        "layer": "rpc",
+        "kinds": {"drop", "duplicate", "truncate", "corrupt_mac"},
+        "desc": "one envelope-lane frame about to hit the transport",
+        "exercises": "peer MAC rejection + connection teardown; caller retry paths",
+    },
+    "rpc.raw.send": {
+        "layer": "rpc",
+        "kinds": {"stall", "drop"},
+        "desc": "one raw-lane chunk frame (bulk object transfer)",
+        "exercises": "pull chunk timeout -> per-chunk failover to an alternate source",
+    },
+    "rpc.recv.dispatch": {
+        "layer": "rpc",
+        "kinds": {"delay"},
+        "desc": "one received envelope about to be dispatched",
+        "exercises": "latency tolerance: timeouts, heartbeat grace, reply ordering",
+    },
+    # -- L2: node daemon / object plane ---------------------------------
+    "node.chunk.serve": {
+        "layer": "node",
+        "kinds": {"evict", "error"},
+        "desc": "a raw-lane chunk read being served from this node's arena",
+        "exercises": "evict: object loss under a borrower -> directory fallback + "
+                     "lineage reconstruction; error: chunk retry / source failover",
+    },
+    "node.pull.source": {
+        "layer": "node",
+        "kinds": {"error"},
+        "desc": "puller side, before fetching a chunk from a chosen source",
+        "exercises": "mid-object source death -> striped failover to alternates",
+    },
+    "node.spill.pread": {
+        "layer": "node",
+        "kinds": {"error"},
+        "desc": "ranged read of a spilled object's file",
+        "exercises": "fail-loud truncated-spill path (no silent short chunks)",
+    },
+    "node.worker.lease": {
+        "layer": "node",
+        "kinds": {"kill", "hang"},
+        "desc": "a worker lease just granted to a submitter",
+        "exercises": "worker death mid-task (delayed SIGKILL) or stall (SIGSTOP): "
+                     "task retry on a fresh worker, daemon death reporting",
+    },
+    "tpu.preempt": {
+        "layer": "accel",
+        "kinds": {"preempt"},
+        "desc": "TPU-preemption notice check (consulted each daemon heartbeat)",
+        "exercises": "node drain + death -> gang/actor reschedule, autoscaler "
+                     "replacement of the preempted slice host",
+    },
+    # -- L3: core worker -------------------------------------------------
+    "worker.task.submit": {
+        "layer": "worker",
+        "kinds": {"error"},
+        "desc": "a task entering the submission queue (PENDING state)",
+        "exercises": "submission-time failure -> task returns fail cleanly, "
+                     "FSM record closes terminal",
+    },
+    "worker.task.dispatch": {
+        "layer": "worker",
+        "kinds": {"error"},
+        "desc": "a task batch about to be pushed to a leased worker",
+        "exercises": "simulated worker loss at dispatch -> retry/backoff path "
+                     "without killing anything",
+    },
+    "worker.exec": {
+        "layer": "worker",
+        "kinds": {"error", "delay", "kill"},
+        "desc": "a normal task about to execute on this worker",
+        "exercises": "error: RemoteError propagation + retries; delay: slow-executor "
+                     "stalls; kill: hard worker death mid-task (os._exit)",
+    },
+    "worker.actor.exec": {
+        "layer": "worker",
+        "kinds": {"error", "delay"},
+        "desc": "an actor method call about to execute",
+        "exercises": "actor call failure/latency; caller-side reply handling",
+    },
+    # -- L1: controller ---------------------------------------------------
+    "controller.heartbeat": {
+        "layer": "controller",
+        "kinds": {"drop"},
+        "desc": "a node heartbeat arriving at the controller",
+        "exercises": "heartbeat-loss tolerance vs the node-death timeout",
+    },
+    "controller.lease.grant": {
+        "layer": "controller",
+        "kinds": {"delay", "error"},
+        "desc": "a worker-lease request being granted",
+        "exercises": "lease-grant latency and failure -> submitter retry loop",
+    },
+}
+
+
+def catalog() -> list:
+    """Rows for the CLI / README: (site, layer, kinds, description)."""
+    return [
+        {"site": name, "layer": row["layer"], "kinds": sorted(row["kinds"]),
+         "desc": row["desc"], "exercises": row["exercises"]}
+        for name, row in sorted(SITES.items())
+    ]

@@ -106,7 +106,23 @@ _MINTED_HISTORY: set = set()
 
 
 def _token_owned_by_live_cluster(token: str) -> bool:
-    return any(c.config.auth_token == token for c in _LIVE_CLUSTERS)
+    """True only when a genuinely-live in-process Cluster owns ``token``.
+
+    Compares against each cluster's token SNAPSHOT (``_session_token``,
+    frozen at construction), never the live shared Config: every in-process
+    Cluster aliases the process-global Config object, so ``c.config
+    .auth_token == token`` was trivially true for ANY current token whenever
+    a stale record survived in _LIVE_CLUSTERS — one leaked cluster record
+    made this predicate veto every later scrub and stale-mint drop in the
+    process (the round-5 full-suite test_start_cli failures: the leaked
+    record "owned" whatever token happened to be in the config). A cluster
+    whose service thread is gone cannot be serving anyone either way."""
+    return any(
+        c._session_token and c._session_token == token
+        and getattr(getattr(c, "host", None), "thread", None) is not None
+        and c.host.thread.is_alive()
+        for c in _LIVE_CLUSTERS
+    )
 
 
 def _drop_stale_minted_token(cfg) -> None:
@@ -151,6 +167,10 @@ class Cluster:
             self._minted_token = True
         else:
             self._minted_token = False
+        # Ownership snapshot: the token THIS cluster serves with, frozen now.
+        # _token_owned_by_live_cluster compares against this, not the live
+        # (shared, mutable) Config field.
+        self._session_token = self.config.auth_token
         if self.config.auth_token:
             from ray_tpu.core import rpc as _rpc
 
@@ -247,23 +267,33 @@ class Cluster:
                 self._token_file = None
             if self in _LIVE_CLUSTERS:
                 _LIVE_CLUSTERS.remove(self)
-            if self._minted_token and _LIVE_CLUSTERS:
-                # A later-created Cluster inherited this token; hand the scrub
-                # duty to it so the LAST sharer cleans up.
-                _LIVE_CLUSTERS[0]._minted_token = True
+            # Hand the scrub duty to a later-created Cluster ONLY if it
+            # actually shares this session's token (it adopted ours from the
+            # shared config). Handing it to an arbitrary survivor — as the
+            # old `_LIVE_CLUSTERS[0]` did — parked the duty on unrelated
+            # (possibly stale) records that never scrub.
+            sharers = [c for c in _LIVE_CLUSTERS if c._session_token == self._session_token]
+            if self._minted_token and sharers:
+                sharers[0]._minted_token = True
                 self._minted_token = False
-            if self._minted_token and not _LIVE_CLUSTERS:
+            if self._minted_token:
                 # Restore whatever the environment pins (usually ""): a later
                 # init(address=...) in this process must fall through to the
                 # session-token-file / RAYTPU_AUTH_TOKEN discovery path instead
                 # of reusing this dead session's secret. Scrub the rpc-module
                 # copy too — the direct-Cluster path (no api.shutdown) must not
-                # keep MAC-tagging frames with the dead secret. Skipped while
-                # another live Cluster in this process shares the token.
+                # keep MAC-tagging frames with the dead secret — UNLESS a
+                # genuinely-live (thread running) other Cluster still needs
+                # the process-wide frame key for its own session.
                 from ray_tpu.core import rpc as _rpc
 
                 self.config.auth_token = type(self.config)().apply_env().auth_token
-                if not self.config.auth_token:
+                others_alive = any(
+                    getattr(getattr(c, "host", None), "thread", None) is not None
+                    and c.host.thread.is_alive()
+                    for c in _LIVE_CLUSTERS
+                )
+                if not self.config.auth_token and not others_alive:
                     _rpc.set_auth_token(None)
                 self._minted_token = False
 

@@ -113,6 +113,14 @@ class Config:
     # Per-task state index bound on the controller ((task_id, attempt)
     # records); overflow evicts terminal-first and counts tasks_evicted.
     task_index_size: int = 8192
+    # --- chaos (deterministic fault injection; see ray_tpu/chaos/) ---
+    # JSON FaultSchedule spec ({"seed": N, "rules": [...]}) armed in EVERY
+    # process of the session: the head pushes it with the rest of the config
+    # (daemons/workers install at registration) and spawned workers also get
+    # it via RAYTPU_CHAOS_SPEC env so faults arm before their first task.
+    # Empty (the default) keeps the chaos plane entirely off — the gate is a
+    # single attribute load + None check (bench detail.chaos_overhead).
+    chaos_spec: str = ""
     # --- security ---
     # OPT-IN per-session shared secret for the RPC layer (pickle-over-TCP
     # executes code on unpickle; with a token set, every frame carries an

@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ray_tpu import chaos as _chaos
 from ray_tpu.core import rpc
 from ray_tpu.core import task_state as _ts
 from ray_tpu.core.config import Config
@@ -200,6 +201,11 @@ class Controller:
 
     # ------------------------------------------------------------------
     async def start(self, port: int = 0) -> str:
+        if self.config.chaos_spec:
+            # The head arms its own chaos plane from the same config it
+            # pushes to every daemon/worker (controller-side sites:
+            # heartbeat drops, lease-grant latency/failure).
+            _chaos.install_from_json(self.config.chaos_spec)
         addr = await self.server.start(port)
         self._bg.append(asyncio.create_task(self._health_check_loop()))
         if self.persist_path:
@@ -497,6 +503,11 @@ class Controller:
         return {"ok": node is not None}
 
     def handle_heartbeat(self, conn, p):
+        fault = _chaos.maybe_inject("controller.heartbeat", node=p.get("node_id", "")[:12])
+        if fault is not None and fault.kind == "drop":
+            # A lost heartbeat: last_heartbeat ages toward the death timeout
+            # (enough consecutive drops = injected node-death declaration).
+            return True
         node = self.nodes.get(p["node_id"])
         if node:
             node.last_heartbeat = time.monotonic()
@@ -1177,6 +1188,12 @@ class Controller:
         """
         strategy = p["strategy"]
         demand = p["demand"]
+        fault = _chaos.maybe_inject("controller.lease.grant", lease=p.get("lease_id", ""))
+        if fault is not None:
+            if fault.kind == "delay":
+                await asyncio.sleep(fault.delay_s)  # lease-grant latency
+            elif fault.kind == "error":
+                raise fault.error("lease grant")  # submitter retries the lease
         node = self._pick_node(demand, strategy, p.get("label_selector", {}))
         if node is not None:
             self._consume(node, demand, strategy)
@@ -1186,6 +1203,15 @@ class Controller:
             not self.config.infeasible_as_pending
             and not self._feasible_nodes(demand, p.get("label_selector", {}), include_draining=True)
             and getattr(strategy, "kind", "") != "PLACEMENT_GROUP"
+            # Post-restart reconcile grace (and cold start): daemons
+            # re-register over the next seconds, so an empty/partial node
+            # table is not evidence of infeasibility — fast-failing here
+            # turned every lease that raced a controller restart into a
+            # permanent "infeasible resource demand" task failure (found by
+            # the chaos controller_restart scenario). Park instead; the
+            # register_node retry pass grants it.
+            and self._reconcile_deadline is None
+            and any(n.state == "ALIVE" for n in self.nodes.values())
         ):
             return {"infeasible": True}
         fut = asyncio.get_running_loop().create_future()

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ray_tpu import chaos as _chaos
 from ray_tpu.core import rpc
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
@@ -100,6 +101,9 @@ class NodeDaemon:
         # forwarded to drivers (reference: _private/log_monitor.py side-car).
         self.log_dir = os.path.join(self.session_dir, "logs", self.node_id[:12])
         self._log_monitor = None
+        # Chaos: an injected TPU-preemption notice fired for this node (the
+        # daemon drains, then drops off the cluster after the grace window).
+        self._preempted = False
 
     def _spawn_bg(self, coro, name: str | None = None) -> asyncio.Task:
         """create_task with a strong reference held until completion. Every
@@ -225,10 +229,22 @@ class NodeDaemon:
             },
         )
         self.config = self.config.adopt_cluster(reply["config"])
+        if self.config.chaos_spec:
+            # Arm the chaos plane with the cluster schedule (idempotent for
+            # an identical spec, so controller-restart re-registration does
+            # not reset live hit counters).
+            _chaos.install_from_json(self.config.chaos_spec)
 
     async def _heartbeat_loop(self):
+        from ray_tpu.accel.tpu import preemption_notice
+
         while True:
             await asyncio.sleep(self.config.heartbeat_interval_s)
+            if not self._preempted:
+                fault = preemption_notice(self.node_id, self.labels)
+                if fault is not None:
+                    self._preempted = True
+                    self._spawn_bg(self._preempt_self(fault), name="tpu-preempt")
             try:
                 await self.controller.notify("heartbeat", {
                     "node_id": self.node_id,
@@ -248,6 +264,31 @@ class NodeDaemon:
                 })
             except Exception:
                 pass
+
+    async def _preempt_self(self, fault):
+        """Injected TPU preemption (reference: GCE preemption notice -> the
+        slice host disappears after a short grace). Drain first — the
+        scheduler stops placing new work here — then drop off the cluster:
+        workers die with the host and the controller observes the TCP close
+        immediately (no heartbeat-timeout wait), restarting actors and
+        rescheduling gang bundles elsewhere."""
+        logger.warning(
+            "chaos: TPU preemption notice for node %s (grace %.2fs)",
+            self.node_id[:8], fault.delay_s,
+        )
+        try:
+            await self.controller.call("drain_node", {"node_id": self.node_id})
+        except Exception:
+            pass
+        await asyncio.sleep(fault.delay_s)
+        for w in list(self.workers.values()):
+            self._kill_worker_proc(w, "tpu preempted")
+        await self.server.close()
+        if self.controller:
+            # PersistentConnection.close() latches closed: the heartbeat
+            # loop's next notify raises instead of redialing (a preempted
+            # host must not resurrect itself by re-registering).
+            await self.controller.close()
 
     async def _idle_reaper_loop(self):
         while True:
@@ -332,6 +373,11 @@ class NodeDaemon:
         env["RAYTPU_CONTROLLER_ADDR"] = self.controller_addr
         if self.config.auth_token:
             env["RAYTPU_AUTH_TOKEN"] = self.config.auth_token
+        if self.config.chaos_spec:
+            # Arm the worker's chaos plane at process start (worker_main),
+            # BEFORE registration — exec-side faults must be able to hit the
+            # very first task a fresh worker runs.
+            env["RAYTPU_CHAOS_SPEC"] = self.config.chaos_spec
         env["RAYTPU_DAEMON_ADDR"] = self.address
         env["RAYTPU_NODE_IP"] = self.server.host  # workers bind/advertise the node's IP
         env["RAYTPU_STORE_PATH"] = self.store_path
@@ -441,7 +487,27 @@ class NodeDaemon:
         record = await self._acquire_worker(p.get("runtime_env"))
         record.state = "LEASED"
         record.state_ts = time.monotonic()
+        fault = _chaos.maybe_inject("node.worker.lease", worker=record.worker_id[:12])
+        if fault is not None and fault.kind in ("kill", "hang"):
+            # Kill (or SIGSTOP) this worker shortly after the lease lands —
+            # deterministically mid-task for any task longer than delay_s.
+            self._spawn_bg(self._chaos_worker_fault(record, fault), name="chaos-worker-fault")
         return {"worker_id": record.worker_id, "address": record.address}
+
+    async def _chaos_worker_fault(self, record: WorkerRecord, fault):
+        await asyncio.sleep(fault.delay_s)
+        if record.state == "DEAD":
+            return
+        if fault.kind == "hang":
+            # A wedged-but-alive worker: the process stops scheduling but the
+            # TCP connection stays up (the hardest failure shape to detect).
+            if record.proc is not None and record.proc.poll() is None:
+                import signal as _signal
+
+                record.proc.send_signal(_signal.SIGSTOP)
+            return
+        logger.warning("chaos: killing leased worker %s", record.worker_id[:8])
+        self._kill_worker_proc(record, "chaos: injected worker kill")
 
     def handle_return_worker(self, conn, p):
         record = self.workers.get(p["worker_id"])
@@ -574,6 +640,9 @@ class NodeDaemon:
         fd. The reaper closes fds idle >60s; delete closes eagerly."""
         if not self.store.spill_dir:
             return None
+        fault = _chaos.maybe_inject("node.spill.pread", oid=oid.hex()[:16])
+        if fault is not None and fault.kind == "error":
+            return None  # unreadable spill file: callers fail loud (KeyError)
         key = oid.binary()
         ent = self._spill_fds.get(key)
         if ent is None:
@@ -603,6 +672,26 @@ class NodeDaemon:
         The reply is a tiny ack that can coalesce with other replies."""
         oid = ObjectID(p["oid"])
         offset, length = p["offset"], p["length"]
+        fault = _chaos.maybe_inject("node.chunk.serve", oid=oid.hex()[:16])
+        if fault is not None:
+            if fault.kind == "evict":
+                # The object genuinely disappears from this node (arena AND
+                # spill copy) with the directory told, exactly like real
+                # eviction under a borrower: the puller falls back to the
+                # directory and, with no copies left, the owner reconstructs
+                # via lineage.
+                self._close_spill_fd(oid)
+                self.store.delete(oid, drop_spilled=True)
+                self._spawn_bg(
+                    self.controller.notify(
+                        "report_objects_evicted",
+                        {"oids": [oid.binary()], "node_id": self.node_id},
+                    ),
+                    name="chaos-evict-report",
+                )
+                raise KeyError(f"object {oid.hex()} not in store (chaos-evicted)")
+            if fault.kind == "error":
+                raise fault.error(f"chunk {oid.hex()[:10]}+{offset}")
         view = self.store.get(oid)
         if view is None and self._restore_local(oid):  # restore once, stream from arena
             view = self.store.get(oid)
@@ -1003,6 +1092,12 @@ class PullManager:
             conn = None
             try:
                 conn = await d._peer(src["address"])
+                pull_fault = _chaos.maybe_inject("node.pull.source", source=src["node_id"][:12])
+                if pull_fault is not None and pull_fault.kind == "error":
+                    # Simulated source death mid-object: spends this source's
+                    # failure budget and hard-drops its connection below,
+                    # exactly like a real mid-chunk failure.
+                    raise pull_fault.error(f"source {src['node_id'][:8]}")
                 key = os.urandom(12)
                 fut = conn.expect_raw(key, buf[off : off + ln])
                 try:

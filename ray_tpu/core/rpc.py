@@ -60,6 +60,7 @@ import traceback
 from typing import Any
 
 from ray_tpu.util.bgtasks import spawn_bg as _spawn_bg
+from ray_tpu import chaos as _chaos
 
 logger = logging.getLogger(__name__)
 
@@ -359,9 +360,29 @@ class Connection:
     def _write_frame(self, data: bytes):
         global _SEND_BYTES
         data = _VER + _tag(data) + data if _frame_key else _VER + data
+        fault = _chaos.maybe_inject("rpc.frame.send", peer=self.peer_name)
+        if fault is not None and fault.kind == "drop":
+            return  # frame vanishes; callers see timeouts/conn teardown
+        if fault is not None and fault.kind == "corrupt_mac":
+            # Flip the byte after the version marker. With auth on that is a
+            # tag byte: the peer's constant-time verify fails and drops this
+            # connection (the fail-loud auth contract). With auth OFF it is
+            # the first pickle byte: unpickling fails and the peer's read
+            # loop tears down — a recorded injection must never be a no-op.
+            data = data[:1] + bytes([data[1] ^ 0xFF]) + data[2:]
         _SEND_BYTES += len(data) + _HDR
         try:
-            self.writer.write(len(data).to_bytes(_HDR, "little") + data)
+            wire = len(data).to_bytes(_HDR, "little") + data
+            if fault is not None and fault.kind == "truncate":
+                # Write fewer bytes than the header declares: the peer stalls
+                # mid-frame (a wedged writer) and, when this connection later
+                # carries anything else, misparses it as frame tail — either
+                # way the receiver fails loud and tears the peer down.
+                self.writer.write(wire[: _HDR + 1 + max(1, len(data) // 2)])
+                return
+            self.writer.write(wire)
+            if fault is not None and fault.kind == "duplicate":
+                self.writer.write(wire)
         except Exception:
             pass  # transport gone: the read loop tears the connection down
 
@@ -470,6 +491,12 @@ class Connection:
         global _SEND_BYTES, _RAW_SEND_BYTES
         if self._closed:
             raise ConnectionLost(f"connection to {self.peer_name} closed")
+        fault = _chaos.maybe_inject("rpc.raw.send", peer=self.peer_name)
+        if fault is not None:
+            if fault.kind == "drop":
+                return  # chunk never lands; the puller's deadline fails it over
+            if fault.kind == "stall":
+                await asyncio.sleep(fault.delay_s)
         payload = memoryview(payload)
         hdr = pickle.dumps((key, len(payload)), protocol=5)
         taglen = 2 * _TAG_LEN if _frame_key else 0
@@ -711,6 +738,12 @@ class Connection:
                 # for per-actor FIFO and stream registration is task-creation
                 # order, which equals envelope order).
                 msgs = obj if type(obj) is list else (obj,)
+                fault = _chaos.maybe_inject("rpc.recv.dispatch", peer=self.peer_name)
+                if fault is not None and fault.kind == "delay":
+                    # Latency injection on the receive side (the send side is
+                    # sync): everything in this envelope — replies included —
+                    # lands late, exercising timeout/grace tolerances.
+                    await asyncio.sleep(fault.delay_s)
                 _RECV_BATCH_HIST[len(msgs)] += 1
                 for kind, msg_id, method, payload in msgs:
                     if kind == _REP:

@@ -38,6 +38,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ray_tpu import chaos as _chaos
 from ray_tpu.core import rpc, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
@@ -269,6 +270,12 @@ class _KeySubmitter:
                 # worker — node/worker attribution is known from here on.
                 self.core._task_event("task_dispatched", spec,
                                       node=w.node_id, exec_worker=w.worker_id[:12])
+            fault = _chaos.maybe_inject("worker.task.dispatch", worker=w.worker_id[:12])
+            if fault is not None and fault.kind == "error":
+                # Simulated worker loss at dispatch: RpcError lands in the
+                # except arm below — the real retry/backoff path, with no
+                # process actually harmed (deterministic retry exerciser).
+                raise rpc.RpcError(f"chaos[worker.task.dispatch#{fault.hit}] injected dispatch failure")
             reply = await w.conn.call("push_tasks", {"specs": wire})
             for (spec, fut), r in zip(items, reply["results"]):
                 self.core._absorb_task_reply(spec, r, fut)
@@ -473,6 +480,8 @@ class CoreWorker:
             reply = await self.daemon.call("register_worker", {"worker_id": self.worker_id, "address": self.address})
             self.node_id = reply["node_id"]
             self.config = self.config.adopt_cluster(reply["config"])
+            if self.config.chaos_spec:
+                _chaos.install_from_json(self.config.chaos_spec)
             if self.store is not None:
                 # The store client predates the config push: re-apply
                 # settings that change ITS behavior (a worker without the
@@ -509,6 +518,10 @@ class CoreWorker:
         reply = await conn.call("register_job", payload)
         self.job_id = JobID(reply["job_id"])
         self.config = Config.from_dict(reply["config"])
+        if self.config.chaos_spec:
+            # Driver adopts the cluster chaos schedule with the rest of the
+            # config (idempotent re-install across controller reconnects).
+            _chaos.install_from_json(self.config.chaos_spec)
         if self.store is not None:
             self.store.spill_dir = self.config.object_spill_dir or None
         self._register_reply = reply
@@ -582,6 +595,10 @@ class CoreWorker:
         if self._events_dropped:
             rec("events_dropped_total", "counter", self._events_dropped,
                 {"where": "worker"}, "task events lost to buffer trims before reporting")
+        # chaos.injected_total{site,kind}: THIS process's injections (driver,
+        # spawned worker, or in-process daemons co-resident with a driver) —
+        # no silent injection, every fault reaches /metrics.
+        out.extend(_chaos.metrics_series())
         return out
 
     async def _flush_task_events(self):
@@ -1482,6 +1499,12 @@ class CoreWorker:
     def _enqueue_submit(self, spec: TaskSpec):
         """Hand the (dep-free) spec to its scheduling-key submitter. Plain
         function so the no-deps fast path skips a per-call coroutine+task."""
+        fault = _chaos.maybe_inject("worker.task.submit", fn=_spec_fn_name(spec))
+        if fault is not None and fault.kind == "error":
+            # Submission-time failure: the task's returns fail cleanly and
+            # its FSM record closes terminal (never enters a queue).
+            self._fail_task_returns(spec, fault.error(f"submit {_spec_fn_name(spec)}"))
+            return
         key = scheduling_key(spec.fn_id, spec.options)
         sub = self._submitters.get(key)
         if sub is None:
@@ -1648,6 +1671,18 @@ class CoreWorker:
                                  span_id=spec._exec_ctx[1])
             t0 = time.monotonic()
             try:
+                fault = _chaos.maybe_inject("worker.exec", fn=_spec_fn_name(spec))
+                if fault is not None:
+                    if fault.kind == "kill":
+                        # Hard worker death mid-task (the SIGKILL shape): no
+                        # reply ever leaves this process; the caller's retry
+                        # path resubmits on a fresh worker.
+                        logger.warning("chaos: worker.exec kill (task %s)", spec.task_id.hex()[:8])
+                        os._exit(1)
+                    if fault.kind == "delay":
+                        await asyncio.sleep(fault.delay_s)  # slow-executor stall
+                    elif fault.kind == "error":
+                        raise fault.error(f"task {_spec_fn_name(spec)}")
                 if streaming:
                     n = await self._execute_streaming_task(conn, fn, spec, loop)
                     return {"status": "ok", "streaming_done": n}
@@ -2454,6 +2489,12 @@ class ActorRuntime:
                 "error": RemoteError.from_exception(AttributeError(f"no method {spec.method_name}"), "actor task"),
             }
         try:
+            fault = _chaos.maybe_inject("worker.actor.exec", method=spec.method_name)
+            if fault is not None:
+                if fault.kind == "delay":
+                    await asyncio.sleep(fault.delay_s)
+                elif fault.kind == "error":
+                    raise fault.error(f"actor method {spec.method_name}")
             if spec.num_returns == -1:  # streaming generator method
                 n = await self._execute_streaming(method, spec, conn)
                 return {"status": "ok", "streaming_done": n}

@@ -26,6 +26,12 @@ def main():
     from ray_tpu.core.worker import CoreWorker
 
     rpc.set_auth_token(os.environ.get("RAYTPU_AUTH_TOKEN", ""))
+    if os.environ.get("RAYTPU_CHAOS_SPEC"):
+        # Arm the chaos plane before ANY task can execute (the cluster config
+        # re-install at registration is a no-op for the identical spec).
+        from ray_tpu import chaos
+
+        chaos.install_from_json(os.environ["RAYTPU_CHAOS_SPEC"])
     controller_addr = os.environ["RAYTPU_CONTROLLER_ADDR"]
     core = CoreWorker(mode="worker", controller_addr=controller_addr)
     loop = asyncio.new_event_loop()

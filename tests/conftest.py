@@ -37,10 +37,73 @@ def shared_ray():
 @pytest.fixture
 def fresh_cluster():
     from ray_tpu.core.api import Cluster
+    from ray_tpu.core.config import get_config
 
+    # Tests tune the cluster's knobs (inline caps, chunk sizes) through
+    # cluster.config — which IS the process-global Config. Snapshot and
+    # restore it, or one test's tuning silently reshapes every later module
+    # (a 4 MiB inline cap left by test_object_transfer flipped
+    # test_state_api's shm attribution to "memory" 40 tests later).
+    cfg = get_config()
+    snap = cfg.to_dict()
     cluster = Cluster(initialize_head=False)
     yield cluster
     cluster.shutdown()
+    for k, v in snap.items():
+        setattr(cfg, k, v)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _no_cluster_leaks(request):
+    """Module-boundary leak sentinel (round-5 verdict action item): a module
+    that leaves a live in-process Cluster, an initialized driver session, or
+    a session auth token behind fails HERE — at the leak's source — instead
+    of poisoning whatever module happens to run 40 tests later (the
+    test_start_cli order-sensitivity was exactly such a leak: clusters whose
+    tests called only rt.shutdown(), which detaches the driver but never
+    stops an address-connected cluster). The sentinel also cleans up so one
+    leaky module still can't cascade."""
+    from ray_tpu.core import api, rpc
+    from ray_tpu.core.config import get_config
+
+    before = list(api._LIVE_CLUSTERS)
+    cfg_before = get_config().to_dict()
+    yield
+    leaks = []
+    if api._global_worker is not None:
+        leaks.append("driver session left initialized (missing rt.shutdown())")
+        try:
+            api.shutdown()
+        except Exception:
+            pass
+    for c in [c for c in list(api._LIVE_CLUSTERS) if c not in before]:
+        leaks.append(
+            f"in-process Cluster {getattr(c, 'controller_addr', '?')} left running "
+            "(rt.shutdown() detaches the driver; call cluster.shutdown() too)"
+        )
+        try:
+            c.shutdown()
+        except Exception:
+            pass
+    cfg = get_config()
+    env_token = type(cfg)().apply_env().auth_token
+    if cfg.auth_token and cfg.auth_token != env_token and not api._token_owned_by_live_cluster(cfg.auth_token):
+        leaks.append(f"session auth token '{cfg.auth_token[:8]}…' leaked into the global config")
+        cfg.auth_token = env_token
+        rpc.set_auth_token(env_token or None)
+    # Config drift: tests tune cluster knobs through the process-global
+    # Config (cluster.config aliases it); a module must put back what it
+    # changed or it silently reshapes every later module's clusters.
+    drift = {
+        k: (cfg_before[k], v) for k, v in get_config().to_dict().items()
+        if k != "auth_token" and v != cfg_before[k]
+    }
+    if drift:
+        leaks.append(f"process-global Config drifted: {drift}")
+        for k, v in cfg_before.items():
+            if k != "auth_token":
+                setattr(cfg, k, v)
+    assert not leaks, f"{request.module.__name__} leaked cross-test state:\n  " + "\n  ".join(leaks)
 
 
 # Per-test timeout (reference: pytest.ini's 180s default): one hung

@@ -802,6 +802,122 @@ def test_syntax_error_is_reported_not_crashed():
 # the tier-1 gate: whole tree at zero, report written, CLI contract
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# chaos-gate
+# ---------------------------------------------------------------------------
+
+def test_chaos_gate_fires_on_dynamic_site_name():
+    r = _lint("""
+        from ray_tpu import chaos
+
+        def serve(name):
+            chaos.maybe_inject(name)
+            chaos.maybe_inject("prefix." + name)
+            chaos.maybe_inject(f"site.{name}")
+    """)
+    assert [f.line for f in r.findings if f.rule == "chaos-gate"] == [5, 6, 7]
+
+
+def test_chaos_gate_fires_on_duplicate_site_name():
+    r = _lint("""
+        from ray_tpu import chaos as _chaos
+
+        def a():
+            _chaos.maybe_inject("node.thing")
+
+        def b():
+            _chaos.maybe_inject("node.thing")
+    """)
+    hits = [f for f in r.findings if f.rule == "chaos-gate"]
+    assert len(hits) == 1 and hits[0].line == 8 and "duplicate" in hits[0].message
+
+
+def test_chaos_gate_duplicate_detection_is_tree_wide(tmp_path):
+    from ray_tpu.analysis import lint_paths
+
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("from ray_tpu import chaos\n\n\ndef f():\n    chaos.maybe_inject('x.y')\n")
+    b.write_text("from ray_tpu import chaos\n\n\ndef g():\n    chaos.maybe_inject('x.y')\n")
+    result = lint_paths([str(a), str(b)])
+    hits = [f for f in result.findings if f.rule == "chaos-gate"]
+    assert len(hits) == 1 and hits[0].path == str(b), hits
+
+
+def test_chaos_gate_fires_on_adhoc_branching_and_internals():
+    r = _lint("""
+        from ray_tpu import chaos
+
+        def f():
+            if chaos.active() is not None:   # ad-hoc chaos branch
+                raise RuntimeError("my own fault")
+            chaos._PLAN = None               # plan internals
+    """)
+    assert [f.line for f in r.findings if f.rule == "chaos-gate"] == [5, 7]
+
+
+def test_chaos_gate_fires_on_internal_imports_outside_pkg():
+    r = _lint("""
+        from ray_tpu.chaos import injection_log
+        from ray_tpu.chaos.plan import maybe_inject
+    """)
+    assert [f.line for f in r.findings if f.rule == "chaos-gate"] == [2, 3]
+
+
+def test_chaos_gate_clean_on_sanctioned_idiom():
+    r = _lint("""
+        from ray_tpu import chaos as _chaos
+
+        def write_frame(self, data):
+            fault = _chaos.maybe_inject("my.site", peer=self.peer)
+            if fault is not None and fault.kind == "drop":
+                return
+            _chaos.install_from_json("{}")
+            series = _chaos.metrics_series()
+    """)
+    assert "chaos-gate" not in _rules_hit(r)
+
+
+def test_chaos_gate_exempts_the_chaos_package_itself():
+    r = _lint("""
+        from ray_tpu.chaos import plan as _plan
+
+        def runner():
+            if _plan.active() is not None:
+                pass
+    """, path="ray_tpu/chaos/scenarios.py")
+    assert "chaos-gate" not in _rules_hit(r)
+
+
+def test_chaos_gate_suppression_cases():
+    fires = """
+        from ray_tpu import chaos
+
+        def f(name):
+            chaos.maybe_inject(name){}
+    """
+    r = _lint(fires.format("  # graftlint: disable=chaos-gate  fixture exercises dynamic names"))
+    assert "chaos-gate" not in _rules_hit(r)
+    r = _lint(fires.format("  # graftlint: disable=chaos-gate"))
+    assert {"chaos-gate", BAD_SUPPRESSION} <= _rules_hit(r)
+
+
+def test_chaos_site_catalog_matches_tree():
+    """Every cataloged site has exactly one gate in the tree and every gate
+    is cataloged — the catalog IS the schedule-validation ground truth."""
+    from ray_tpu.analysis import lint_paths
+    from ray_tpu.chaos.sites import SITES
+
+    result = lint_paths([PKG_DIR])
+    woven = set()
+    for _path, stats in result.stats.items():
+        woven.update(stats.get("chaos-gate", {}).get("sites", []))
+    assert woven == set(SITES), (
+        f"cataloged-but-unwoven: {sorted(set(SITES) - woven)}; "
+        f"woven-but-uncataloged: {sorted(woven - set(SITES))}"
+    )
+
+
 def test_whole_tree_zero_findings_and_write_lint_json():
     """The regression gate that keeps future PRs honest: every invariant
     violation in the shipped tree is either fixed or suppressed with a

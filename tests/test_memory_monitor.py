@@ -95,6 +95,10 @@ def test_oom_killed_task_is_retried():
         assert mon.kills == 1
     finally:
         rt.shutdown()
+        # rt.shutdown() only detaches the driver; the Cluster (service
+        # thread, daemons, minted token) must be stopped explicitly or it
+        # leaks into every later test module.
+        cluster.shutdown()
 
 
 async def _poll_async_inner(mon):

@@ -22,6 +22,12 @@ def two_node_ray():
     init(address=cluster.address)
     yield cluster, n1, n2
     shutdown()
+    # shutdown() detaches the DRIVER only — an address-connected session
+    # never owns the cluster it dialed. Leaving this cluster running leaked
+    # its service thread + minted auth token into every later module (the
+    # round-5 test_start_cli order sensitivity); conftest's module-boundary
+    # sentinel now fails any module that forgets this line.
+    cluster.shutdown()
 
 
 def test_custom_resource_routing(two_node_ray):
