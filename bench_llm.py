@@ -397,6 +397,15 @@ def main():
         "value": serve_r["ttft_p50_s"],
         "unit": "s",
         "vs_baseline": None,  # reference publishes no TPU serving numbers (BASELINE.md)
+        # First-class serve-vs-engine overhead so the serving stack's cost
+        # trajectory is diffable across rounds: bare-engine decode throughput
+        # over client-observed serve throughput (1.0 = the stack is free),
+        # plus the TTFT the stack adds at p50.
+        "serve_overhead_x": round(
+            engine_r["decode_tokens_per_sec"]
+            / max(serve_r["decode_tokens_per_sec"], 1e-9), 3),
+        "serve_ttft_overhead_s": round(
+            serve_r["ttft_p50_s"] - engine_r["ttft_p50_s"], 4),
         "detail": {
             "engine": engine_r,
             "serve": serve_r,

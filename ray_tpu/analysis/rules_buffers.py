@@ -7,7 +7,8 @@ untallied reads as "never happened").
 Detected trim shapes:
   * slice deletes            ``del self.events[:trimmed]``
   * oldest-entry evictions   ``d.pop(next(iter(d)))``
-  * bounded deques           ``deque(maxlen=N)`` (append-side discards are
+  * bounded deques           ``deque(maxlen=N)`` or positional
+                             ``deque(it, N)`` (append-side discards are
                              implicit, so the counter duty attaches to the
                              constructor's class)
 
@@ -125,12 +126,21 @@ class CountedTrims(Rule):
             return
         name = attr or (fn.id if isinstance(fn, ast.Name) else "")
         if name == "deque":
-            for kw in node.keywords:
-                if kw.arg == "maxlen" and not (
+            bounded = any(
+                kw.arg == "maxlen" and not (
                     isinstance(kw.value, ast.Constant) and kw.value.value is None
-                ):
-                    region = self._classes[-1] if self._classes else self._module
-                    region.deques.append(_span(node))
+                )
+                for kw in node.keywords
+            )
+            # maxlen can also arrive positionally — deque(iterable, maxlen) —
+            # which bounds the buffer exactly the same way (the shape the
+            # streaming fast lane's bounded-buffer review turned up missing).
+            if not bounded and len(node.args) >= 2:
+                a = node.args[1]
+                bounded = not (isinstance(a, ast.Constant) and a.value is None)
+            if bounded:
+                region = self._classes[-1] if self._classes else self._module
+                region.deques.append(_span(node))
 
     def leave(self, node: ast.AST, ctx: FileContext) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and self._funcs:

@@ -30,6 +30,14 @@ SITES: dict = {
         "desc": "one received envelope about to be dispatched",
         "exercises": "latency tolerance: timeouts, heartbeat grace, reply ordering",
     },
+    "rpc.stream.item": {
+        "layer": "rpc",
+        "kinds": {"drop", "delay"},
+        "desc": "one generator_items batch frame about to ship to the stream consumer",
+        "exercises": "drop: the frame is lost with its transport (conn torn down) -> "
+                     "caller's connection-loss retry resubmits and the replay's "
+                     "duplicate indices dedup; delay: slow token-stream tolerance",
+    },
     # -- L2: node daemon / object plane ---------------------------------
     "node.chunk.serve": {
         "layer": "node",

@@ -67,6 +67,14 @@ class Config:
     # Per-chunk deadline; on expiry the source connection is dropped (it may
     # be mid-frame) and the chunk retries against an alternate replica.
     pull_chunk_timeout_s: float = 30.0
+    # --- streaming generators (the token path of serve/LLM responses) ---
+    # Bound on items buffered per stream between the producing generator and
+    # the loop-side pump that ships them as batched generator_items frames.
+    # The producer blocks (backpressure) when the buffer is full; the pump
+    # ships whatever is pending each time it runs, so a lone item still
+    # flushes the tick it is produced (TTFT unaffected). Larger values
+    # deepen batches for fast producers at the cost of more buffered values.
+    stream_buffer_items: int = 32
     # --- workers ---
     num_workers_soft_limit: int = 0  # 0 => num_cpus
     worker_register_timeout_s: float = 30.0

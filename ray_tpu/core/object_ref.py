@@ -107,6 +107,10 @@ class ObjectRefGenerator:
         # Early-close hook (set at submit time): tells the producing worker
         # to stop at its next yield (reference: CancelTask for streaming).
         self._cancel = None
+        # Optional arrival callback for async consumers (the serve proxy):
+        # invoked after items/finish land so an event loop can wake and
+        # drain via poll() instead of parking a thread in __next__.
+        self._wakeup = None
 
     # -- producer side (IO loop) --------------------------------------
     def reserve(self, index: int) -> bool:
@@ -121,6 +125,17 @@ class ObjectRefGenerator:
         with self._cond:
             self._items[index] = ref
             self._cond.notify_all()
+        self._notify_wakeup()
+
+    def _push_many(self, pairs):
+        """Absorb one batch frame's refs under a single lock acquisition
+        (one notify_all for N items — the owner-side half of the streaming
+        fast lane's batching)."""
+        with self._cond:
+            for index, ref in pairs:
+                self._items[index] = ref
+            self._cond.notify_all()
+        self._notify_wakeup()
 
     def _finish(self, total: Optional[int] = None, error: BaseException | None = None):
         with self._cond:
@@ -132,6 +147,15 @@ class ObjectRefGenerator:
                     # Hand out what already arrived, then raise.
                     self._total = max(self._items, default=-1) + 1
             self._cond.notify_all()
+        self._notify_wakeup()
+
+    def _notify_wakeup(self):
+        wake = self._wakeup
+        if wake is not None:
+            try:
+                wake()
+            except Exception:
+                pass  # a dead consumer loop must not poison the producer
 
     # -- consumer side -------------------------------------------------
     def __iter__(self):
@@ -164,6 +188,35 @@ class ObjectRefGenerator:
                 if remaining is not None and remaining <= 0:
                     raise GetTimeoutError("generator item timeout")
                 self._cond.wait(remaining if remaining is not None else 1.0)
+
+    def set_wakeup(self, cb):
+        """Register an arrival callback for async consumption (see poll);
+        called after every push/finish, outside the lock."""
+        self._wakeup = cb
+        # Items that landed before registration would otherwise never wake
+        # the consumer: fire once so it drains the backlog immediately.
+        if cb is not None:
+            self._notify_wakeup()
+
+    def poll(self):
+        """Non-blocking probe for async consumers: returns one of
+        ('item', ObjectRef) — the next indexed item (consumption-acked like
+        __next__), ('wait', None) — nothing available yet (await the wakeup
+        callback), ('end', None) — exhausted, or ('error', err) — the stream
+        failed after handing out everything that arrived."""
+        with self._cond:
+            if self._next in self._items:
+                ref = self._items.pop(self._next)
+                self._next += 1
+                ack, consumed = self._ack, self._next
+                if ack is not None:
+                    ack(consumed)
+                return ("item", ref)
+            if self._total is not None and self._next >= self._total:
+                if self._error is not None:
+                    return ("error", self._error)
+                return ("end", None)
+            return ("wait", None)
 
     def completed(self) -> bool:
         with self._cond:

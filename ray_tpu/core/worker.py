@@ -26,6 +26,7 @@ main thread in workers); user code runs on executor threads.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import collections
 import concurrent.futures
 import functools
@@ -70,6 +71,22 @@ _task_latency = _metrics.Histogram(
 )
 _task_latency_task = _task_latency.bind({"kind": "task"})
 _task_latency_actor = _task_latency.bind({"kind": "actor"})
+
+# Owner-side streamed-batch histogram ({items-per-generator_items-frame:
+# frames}) across every stream this process consumes — the streaming lane's
+# analogue of rpc.batch_stats (bench_core reports it in the
+# streaming_generator_items row's detail; _runtime_series promotes it to the
+# stream.batch.items metric on /metrics).
+_STREAM_BATCH_HIST: collections.Counter = collections.Counter()
+_STREAM_BATCH_BUCKETS = [1, 2, 4, 8, 16, 32, 64]
+
+
+def stream_batch_stats(reset: bool = False) -> dict:
+    """{items-per-batch-frame: frames} absorbed by this process's streams."""
+    out = {k: v for k, v in sorted(_STREAM_BATCH_HIST.items())}
+    if reset:
+        _STREAM_BATCH_HIST.clear()
+    return out
 
 
 _MISS = object()  # sentinel: value not locally resident
@@ -325,6 +342,188 @@ class _KeySubmitter:
                 await self._drop_worker(w)
 
 
+class _StreamShipper:
+    """Executor-side fast lane for one streaming generator task: a bounded
+    per-stream buffer the producer appends into (cross-thread ``put`` for
+    thread-run generators, loop-side ``aput`` for async generators), drained
+    by a single loop-side pump that ships every adjacent item as ONE
+    ``generator_items`` batch frame — one pickle+MAC+write per burst instead
+    of a full cross-thread round trip per yielded item (the PR-1 coalescing
+    move applied to the token path of every streamed response). A lone item
+    still flushes the tick it lands: the pump is armed by the buffer's
+    empty->nonempty transition, never a timer, so first-item latency stays
+    one thread handoff — exactly what the old per-item path paid.
+
+    Backpressure: the producer blocks (or awaits) while the buffer is full,
+    and — when ``TaskOptions.generator_backpressure`` is set — while it runs
+    more than ``bp`` items ahead of the consumer's acked consumption. Acks
+    arrive batch-granular (the owner coalesces per-item consumption into one
+    generator_ack per burst; see CoreWorker._install_stream_ack).
+    """
+
+    def __init__(self, core: "CoreWorker", conn, spec: TaskSpec, loop):
+        self.core = core
+        self.conn = conn
+        self.spec = spec
+        self.loop = loop
+        self.tid = spec.task_id.binary()
+        bp = getattr(spec.options, "generator_backpressure", -1)
+        self.bp = bp if bp and bp > 0 else 0
+        self.limit = max(1, core.config.stream_buffer_items)
+        self._cond = threading.Condition()
+        self.buf: list = []  # [(index, value)] pending ship, index order
+        self.consumed = 0  # consumer-acked high-water mark (IO loop writes)
+        self.closed = False  # consumer abandoned the stream
+        self.error: Optional[BaseException] = None  # ship failure -> producer
+        self.items_dropped = 0  # buffered items discarded at close (tallied)
+        self._pump_armed = False
+        self._aev = asyncio.Event()  # wakes loop-side waiters (async gens)
+
+    # -- producer side --------------------------------------------------
+    def _ready_locked(self, index: int) -> bool:
+        return len(self.buf) < self.limit and (
+            not self.bp or index - self.consumed < self.bp
+        )
+
+    def put(self, index: int, value) -> None:
+        """Producer-thread append; blocks only while the buffer is full or
+        the consumption bound is exhausted (backpressure semantics of the
+        old per-item path, preserved)."""
+        with self._cond:
+            while True:
+                if self.closed or self.tid in self.core._cancelled_streams:
+                    raise _StreamClosed()
+                if self.error is not None:
+                    raise self.error
+                if self._ready_locked(index):
+                    break
+                self._cond.wait()
+            self.buf.append((index, value))
+            arm = not self._pump_armed
+            if arm:
+                self._pump_armed = True
+        if arm:
+            self.loop.call_soon_threadsafe(self._pump_start)
+
+    async def aput(self, index: int, value) -> None:
+        """Loop-side append for async generators (never blocks the loop;
+        room/ack waits ride an asyncio.Event the IO-loop writers set)."""
+        while True:
+            with self._cond:
+                if self.closed or self.tid in self.core._cancelled_streams:
+                    raise _StreamClosed()
+                if self.error is not None:
+                    raise self.error
+                if self._ready_locked(index):
+                    self.buf.append((index, value))
+                    arm = not self._pump_armed
+                    if arm:
+                        self._pump_armed = True
+                    break
+                self._aev.clear()
+            await self._aev.wait()
+        if arm:
+            self._pump_start()
+
+    def finish(self) -> None:
+        """Producer exhausted: wait for the pump to drain, then surface any
+        ship failure (the old per-item path raised it at the failing item;
+        here it lands at the next put or at finish)."""
+        with self._cond:
+            while self._pump_armed and self.error is None:
+                self._cond.wait()
+            if self.error is not None and not self.closed:
+                raise self.error
+
+    async def afinish(self) -> None:
+        while True:
+            with self._cond:
+                if not self._pump_armed or self.error is not None:
+                    if self.error is not None and not self.closed:
+                        raise self.error
+                    return
+                self._aev.clear()
+            await self._aev.wait()
+
+    # -- IO-loop side ---------------------------------------------------
+    def on_ack(self, consumed: int) -> None:
+        with self._cond:
+            if consumed > self.consumed:
+                self.consumed = consumed
+                self._cond.notify_all()
+        self._aev.set()
+
+    def close_consumer(self) -> None:
+        """Consumer abandoned the stream: discard what is buffered (tallied
+        — no silent caps) and wake any blocked producer so it observes the
+        close at its next yield."""
+        with self._cond:
+            self.closed = True
+            n = len(self.buf)
+            if n:
+                self.items_dropped += n
+                del self.buf[:n]
+            self._cond.notify_all()
+        self._aev.set()
+
+    def _pump_start(self) -> None:
+        self.core._spawn_bg(
+            self._pump(), name=f"stream-pump-{self.spec.task_id.hex()[:8]}"
+        )
+
+    async def _pump(self) -> None:
+        """Drain the buffer until empty: each swap ships as one batch frame.
+        Single-instance per stream (the armed flag), so wire order == index
+        order; re-armed by the producer's next empty->nonempty append."""
+        while True:
+            with self._cond:
+                batch, self.buf = self.buf, []
+                if not batch:
+                    self._pump_armed = False
+                    self._cond.notify_all()
+                    self._aev.set()
+                    return
+                self._cond.notify_all()  # room freed: unblock the producer
+            self._aev.set()
+            try:
+                items = []
+                for index, value in batch:
+                    items.append((index, await self.core._package_value(
+                        ObjectID.for_return(self.spec.task_id, index), value
+                    )))
+                fault = _chaos.maybe_inject(
+                    "rpc.stream.item", task=self.spec.task_id.hex()[:8],
+                    attempt=getattr(self.spec, "_attempts", 0),
+                )
+                if fault is not None:
+                    if fault.kind == "delay":
+                        await asyncio.sleep(fault.delay_s)
+                    elif fault.kind == "drop":
+                        # A lost frame on a healthy-looking conn would strand
+                        # the consumer waiting for the missing indices, so a
+                        # real transport that eats a frame kills the
+                        # connection — emulate exactly that: the caller's
+                        # connection-loss retry resubmits on a fresh worker
+                        # and the replay's duplicate indices dedup owner-side.
+                        await self.conn.close()
+                        raise rpc.ConnectionLost(
+                            f"chaos[rpc.stream.item#{fault.hit}] dropped "
+                            "generator batch frame"
+                        )
+                await self.conn.notify("generator_items", {
+                    "task_id": self.tid,
+                    "items": items,
+                    "want_ack": bool(self.bp),
+                })
+            except BaseException as e:  # noqa: BLE001 - surfaced to the producer
+                with self._cond:
+                    self.error = e
+                    self._pump_armed = False
+                    self._cond.notify_all()
+                self._aev.set()
+                return
+
+
 class CoreWorker:
     def __init__(self, mode: str, controller_addr: str, config: Config | None = None):
         self.mode = mode  # "driver" | "worker"
@@ -382,8 +581,11 @@ class CoreWorker:
         # task_id bytes -> ObjectRefGenerator (reference: TaskManager's
         # streaming-generator return bookkeeping).
         self._streaming: dict[bytes, "ObjectRefGenerator"] = {}
-        # Executor side: consumer-ack state per backpressured stream.
-        self._gen_ack_state: dict[bytes, dict] = {}
+        # Executor side: per-stream batch shipper (bounded buffer + pump).
+        self._stream_shippers: dict[bytes, "_StreamShipper"] = {}
+        # Early-close discards, folded in at stream cleanup (the per-shipper
+        # tallies die with their streams; this survives for /metrics).
+        self._stream_items_dropped = 0
         # Caller side: the conn each live stream was pushed over, so a
         # consumer close can reach the producing worker (reference:
         # CoreWorkerService.CancelTask applied to streaming generators).
@@ -595,6 +797,27 @@ class CoreWorker:
         if self._events_dropped:
             rec("events_dropped_total", "counter", self._events_dropped,
                 {"where": "worker"}, "task events lost to buffer trims before reporting")
+        if _STREAM_BATCH_HIST:
+            # Streamed-item batch-size histogram (owner side): how many items
+            # each generator_items frame carried — the live-cluster view of
+            # the streaming fast lane's coalescing (mirrors rpc.envelope.messages).
+            counts = [0] * (len(_STREAM_BATCH_BUCKETS) + 1)
+            total, n_frames = 0.0, 0
+            for size, cnt in _STREAM_BATCH_HIST.items():
+                # Same bucket convention as util.metrics._observe_locked.
+                counts[bisect.bisect_left(_STREAM_BATCH_BUCKETS, size)] += cnt
+                total += size * cnt
+                n_frames += cnt
+            out.append({
+                "name": "stream.batch.items", "kind": "histogram",
+                "description": "items coalesced per generator_items batch frame",
+                "tags": {}, "value": 0.0, "ts": now,
+                "buckets": list(_STREAM_BATCH_BUCKETS), "counts": counts,
+                "sum": total, "n": n_frames,
+            })
+        if self._stream_items_dropped:
+            rec("stream.items_dropped", "counter", self._stream_items_dropped, {},
+                "buffered stream items discarded when the consumer closed early")
         # chaos.injected_total{site,kind}: THIS process's injections (driver,
         # spawned worker, or in-process daemons co-resident with a driver) —
         # no silent injection, every fault reaches /metrics.
@@ -1588,38 +1811,73 @@ class CoreWorker:
         if fut is not None and not fut.done():
             fut.set_result(True)
 
-    def handle_generator_item(self, conn, p):
-        """Caller side: one streamed item from an executing generator task
-        (reference: CoreWorkerService.ReportGeneratorItemReturns). Registers
-        the item object under this owner and hands its ref to the consumer."""
+    def handle_generator_items(self, conn, p):
+        """Caller side: one BATCH of streamed items from an executing
+        generator task (reference: ReportGeneratorItemReturns, coalesced).
+        Absorbs N items in one pass — N return objects registered, N refs
+        pushed to the consumer under one lock acquisition — so a deep batch
+        frame costs one dispatch, not N."""
         gen = self._streaming.get(p["task_id"])
-        index = p["index"]
-        if gen is None or not gen.reserve(index):
-            return  # stale task or duplicate index from a retry replay
-        oid = ObjectID.for_return(TaskID(p["task_id"]), index)
-        rec = self._register_owned(oid)
-        rec.local_refs += 1
-        self._absorb_return_item(oid, p["item"])
-        if p.get("want_ack"):
-            # (Re)install on every item: after a connection-loss retry the
-            # stream arrives on a NEW conn — acks pinned to the dead one
-            # would never reach the fresh executor attempt and a
-            # backpressured producer would stall forever.
-            loop = self.loop
+        if gen is None:
+            return  # stale task (consumer already gone)
+        items = p["items"]
+        _STREAM_BATCH_HIST[len(items)] += 1
+        if p.get("want_ack") and getattr(gen, "_ack_conn", None) is not conn:
+            # Install once per (stream, conn) — never per item. Refreshed
+            # only when the conn actually changes: a connection-loss retry
+            # replays the stream on a NEW conn, and acks pinned to the dead
+            # one would stall a backpressured producer forever.
+            self._install_stream_ack(gen, conn, p["task_id"])
+        tid = TaskID(p["task_id"])
+        pushes = []
+        for index, item in items:
+            if not gen.reserve(index):
+                continue  # duplicate index from a retry replay
+            oid = ObjectID.for_return(tid, index)
+            rec = self._register_owned(oid)
+            rec.local_refs += 1
+            self._absorb_return_item(oid, item)
+            ref = ObjectRef(oid, self.address, _register=False)
+            ref._registered = True
+            pushes.append((index, ref))
+        if pushes:
+            gen._push_many(pushes)
 
-            def ack(consumed: int, conn=conn, tb=p["task_id"]):
-                def go():
-                    if not conn.closed:
-                        self._spawn_bg(
-                            conn.notify("generator_ack", {"task_id": tb, "consumed": consumed})
-                        )
+    def _install_stream_ack(self, gen, conn, tb: bytes):
+        """Consumption-ack hook, coalescing: consumer-thread acks record the
+        latest consumed count and arm ONE loop callback per burst, so N
+        items consumed back-to-back cost one self-pipe wakeup and one
+        enqueue-only generator_ack covering the whole batch (batch-granular
+        acks — the producer's backpressure window advances in batches)."""
+        loop = self.loop
+        state = {"armed": False, "value": 0}
 
-                loop.call_soon_threadsafe(go)
+        def send(conn=conn, tb=tb, state=state):
+            # Disarm BEFORE reading the value: a consumption that saw
+            # armed=True happened before the disarm, so its count is
+            # visible to this read; one that misses the window re-arms.
+            state["armed"] = False
+            consumed = state["value"]
+            if not conn.closed:
+                try:
+                    conn.notify_soon(
+                        "generator_ack", {"task_id": tb, "consumed": consumed}
+                    )
+                except rpc.ConnectionLost:
+                    pass
 
-            gen._ack = ack
-        ref = ObjectRef(oid, self.address, _register=False)
-        ref._registered = True
-        gen._push(index, ref)
+        def ack(consumed: int, state=state):
+            state["value"] = consumed
+            if state["armed"]:
+                return
+            state["armed"] = True
+            try:
+                loop.call_soon_threadsafe(send)
+            except RuntimeError:
+                state["armed"] = False
+
+        gen._ack = ack
+        gen._ack_conn = conn
 
     # -- task execution (executor side) --------------------------------
     async def handle_push_tasks(self, conn, p):
@@ -1705,14 +1963,18 @@ class CoreWorker:
                 self._stream_cleanup(spec.task_id.binary())
 
     async def _execute_streaming_task(self, conn, fn, spec: TaskSpec, loop) -> int:
-        """Run a generator task, shipping each yielded item to the caller as
-        its own return object the moment it is produced (reference: streaming
-        generators — ReportGeneratorItemReturns per item, then the final
-        reply). The producing thread blocks until each item frame is on the
-        transport (TCP backpressure only); bounding by CONSUMPTION is opt-in
-        via TaskOptions.generator_backpressure, which pauses the producer
-        until the consumer acks (reference:
-        _generator_backpressure_num_objects, default unbounded)."""
+        """Run a generator task, shipping its yields through the per-stream
+        batch lane: the producing thread appends into a bounded buffer (no
+        cross-thread round trip per item — the old path paid a full
+        run_coroutine_threadsafe().result() per yielded token) and the
+        shipper's loop-side pump coalesces adjacent items into one
+        generator_items frame. Producer blocking semantics are preserved:
+        full buffer (transport backpressure) and, when
+        TaskOptions.generator_backpressure is set, the consumer's acked
+        consumption bound (reference: _generator_backpressure_num_objects,
+        default unbounded)."""
+        shipper = _StreamShipper(self, conn, spec, loop)
+        self._stream_shippers[spec.task_id.binary()] = shipper
 
         def run():
             # Context active for the generator BODY too (it runs during the
@@ -1728,44 +1990,18 @@ class CoreWorker:
                 count = 0
                 for value in out:
                     try:
-                        asyncio.run_coroutine_threadsafe(
-                            self._ship_generator_item(conn, spec, count, value), loop
-                        ).result()
+                        shipper.put(count, value)
                     except _StreamClosed:
                         out.close()
                         break
                     count += 1
+                shipper.finish()
                 return count
             finally:
                 _tracing.deactivate(token)
 
         # Stream state registered/cleaned by handle_push_task's try/finally.
         return await loop.run_in_executor(self._executor, run)
-
-    async def _ship_generator_item(self, conn, spec: TaskSpec, index: int, value):
-        tid = spec.task_id.binary()
-        if tid in self._cancelled_streams:
-            raise _StreamClosed()
-        bp = getattr(spec.options, "generator_backpressure", -1)
-        if bp and bp > 0:
-            st = self._gen_ack_state.setdefault(
-                tid, {"consumed": 0, "event": asyncio.Event()}
-            )
-            while index - st["consumed"] >= bp:
-                st["event"].clear()
-                await st["event"].wait()
-                if tid in self._cancelled_streams:
-                    raise _StreamClosed()
-        item = await self._package_value(ObjectID.for_return(spec.task_id, index), value)
-        await conn.notify(
-            "generator_item",
-            {
-                "task_id": spec.task_id.binary(),
-                "index": index,
-                "item": item,
-                "want_ack": bool(bp and bp > 0),
-            },
-        )
 
     def _stream_register(self, tid: bytes):
         """Mark a streaming task live. MUST run synchronously in the push
@@ -1777,29 +2013,34 @@ class CoreWorker:
     def _stream_cleanup(self, tid: bytes):
         """Single place per-stream executor state dies (idempotent)."""
         self._live_streams.discard(tid)
-        self._gen_ack_state.pop(tid, None)
+        sh = self._stream_shippers.pop(tid, None)
+        if sh is not None:
+            # Fold the shipper's early-close discard tally into the process
+            # counter before its state dies (stream.items_dropped metric).
+            self._stream_items_dropped += sh.items_dropped
         self._cancelled_streams.discard(tid)
 
     def handle_generator_ack(self, conn, p):
-        """Executor side: consumer progress for a backpressured stream."""
-        st = self._gen_ack_state.get(p["task_id"])
-        if st is not None and p["consumed"] > st["consumed"]:
-            st["consumed"] = p["consumed"]
-            st["event"].set()
+        """Executor side: consumer progress for a backpressured stream —
+        one ack can cover a whole consumed batch (the owner coalesces)."""
+        sh = self._stream_shippers.get(p["task_id"])
+        if sh is not None:
+            sh.on_ack(p["consumed"])
 
     def handle_generator_close(self, conn, p):
         """Executor side: the consumer abandoned this stream. Mark it and
-        wake any backpressure-blocked producer so it observes the close.
-        Only streams still executing are marked — a close that races the
-        stream's own completion (its finally already discarded the entry)
-        must not re-add the id, or long-lived workers leak set entries."""
+        wake any blocked producer (buffer-full or backpressure wait) so it
+        observes the close at its next yield. Only streams still executing
+        are marked — a close that races the stream's own completion (its
+        finally already discarded the entry) must not re-add the id, or
+        long-lived workers leak set entries."""
         tid = p["task_id"]
         if tid not in self._live_streams:
             return
         self._cancelled_streams.add(tid)
-        st = self._gen_ack_state.get(tid)
-        if st is not None:
-            st["event"].set()
+        sh = self._stream_shippers.get(tid)
+        if sh is not None:
+            sh.close_consumer()
 
     def cancel_stream(self, task_id_bytes: bytes):
         """Caller side: best-effort early termination of a streaming task the
@@ -2511,11 +2752,15 @@ class ActorRuntime:
             return {"status": "error", "error": RemoteError.from_exception(e, where=f"actor method {spec.method_name}")}
 
     async def _execute_streaming(self, method, spec: TaskSpec, conn) -> int:
-        """Stream a generator actor method's yields to the caller (same wire
-        protocol as streaming normal tasks: one generator_item notify per
-        yield, count in the final reply)."""
+        """Stream a generator actor method's yields to the caller through
+        the same per-stream batch lane as streaming normal tasks: buffered
+        appends drained by a loop-side pump into generator_items frames,
+        count in the final reply. Sync generators append cross-thread (no
+        per-item loop round trip); async generators append loop-side."""
         loop = asyncio.get_running_loop()
         pool, sem, _ = self._lane(spec, method)
+        shipper = _StreamShipper(self.core, conn, spec, loop)
+        self.core._stream_shippers[spec.task_id.binary()] = shipper
         if inspect.isasyncgenfunction(method):
             args, kwargs = await loop.run_in_executor(None, self._resolve, spec.args_blob)
             count = 0
@@ -2526,12 +2771,13 @@ class ActorRuntime:
                     try:
                         async for value in agen:
                             try:
-                                await self.core._ship_generator_item(conn, spec, count, value)
+                                await shipper.aput(count, value)
                             except _StreamClosed:
                                 break
                             count += 1
                     finally:
                         await agen.aclose()
+                await shipper.afinish()
                 return count
             finally:
                 _tracing.deactivate(token)
@@ -2549,13 +2795,12 @@ class ActorRuntime:
                 n = 0
                 for value in out:
                     try:
-                        asyncio.run_coroutine_threadsafe(
-                            self.core._ship_generator_item(conn, spec, n, value), loop
-                        ).result()
+                        shipper.put(n, value)
                     except _StreamClosed:
                         out.close()
                         break
                     n += 1
+                shipper.finish()
                 return n
             finally:
                 _tracing.deactivate(token)

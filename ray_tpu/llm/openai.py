@@ -293,23 +293,58 @@ class OpenAIServer:
                    "model": self.model_name, "choices": [choice]}
         return f"data: {json.dumps(payload)}\n\n"
 
+    def _delta_renderer(self, rid, is_chat):
+        """Pre-render the static SSE envelope once per stream: the per-token
+        cost becomes one json.dumps of the delta STRING spliced between two
+        constant halves, instead of a fresh nested dict + full json.dumps
+        per chunk. Built by dumping the real chunk dict around a sentinel
+        and splitting on it, so the rendered bytes track _chunk's schema
+        exactly (model names with quotes and all). `created` freezes at
+        stream start — one timestamp per stream, the OpenAI convention."""
+        sentinel = "\u0000raytpu\u0000"
+        if is_chat:
+            choice = {"index": 0, "delta": {"content": sentinel}, "finish_reason": None}
+            obj = "chat.completion.chunk"
+        else:
+            choice = {"index": 0, "text": sentinel, "finish_reason": None}
+            obj = "text_completion"
+        envelope = json.dumps({
+            "id": rid, "object": obj, "created": int(time.time()),
+            "model": self.model_name, "choices": [choice],
+        })
+        head, tail = envelope.split(json.dumps(sentinel))
+        head = "data: " + head
+        tail = tail + "\n\n"
+
+        def render(delta_text: str) -> str:
+            return head + json.dumps(delta_text) + tail
+
+        return render
+
     def _stream(self, rid, is_chat, prompt_ids, sp, stops):
         trunc = _StopTruncator(self.tok, stops)
+        render = self._delta_renderer(rid, is_chat)
         first = True
         engine_finish = None
         for ev in self._llm.generate_stream(prompt_ids, sampling=sp):
             delta = trunc.feed(ev.get("new_tokens", ()))
-            if delta or first:
-                yield self._chunk(rid, is_chat, delta, first=first)
+            if first:
+                # First chunk carries the role (chat) — full dict path.
+                yield self._chunk(rid, is_chat, delta, first=True)
                 first = False
+            elif delta:
+                yield render(delta)  # the hot per-token path
             if ev.get("finished"):
                 engine_finish = ev.get("finish_reason")
             if trunc.stopped or ev.get("finished"):
                 break
         tail = trunc.flush()
         if tail:
-            yield self._chunk(rid, is_chat, tail, first=first)
-            first = False
+            if first:
+                yield self._chunk(rid, is_chat, tail, first=True)
+                first = False
+            else:
+                yield render(tail)
         finish = "stop" if trunc.stopped else (engine_finish or "stop")
         yield self._chunk(rid, is_chat, "", finish=finish, first=first)
         yield "data: [DONE]\n\n"

@@ -442,6 +442,47 @@ class DeploymentResponseGenerator:
             self._released = True
             self._rs._release(self._name)
 
+    # -- async consumption (the proxy's no-pump-thread path) ---------------
+    def arm_async(self, loop):
+        """Forward the stream's arrival wakeups onto ``loop``; returns an
+        asyncio.Event set whenever new items (or the finish) land. Pair with
+        poll(): an event loop can drain the stream without parking a thread
+        per chunk in __next__."""
+        import asyncio
+
+        ev = asyncio.Event()
+
+        def wake():
+            try:
+                loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass  # consumer loop already closed; the stream is abandoned
+
+        self._gen.set_wakeup(wake)
+        return ev
+
+    def disarm_async(self):
+        """Drop the wakeup hook (the consumer loop is done with the stream)."""
+        self._gen.set_wakeup(None)
+
+    def poll(self):
+        """Non-blocking probe mirroring __next__'s bookkeeping:
+        ('item', ObjectRef) | ('wait', None) | ('end', None) |
+        ('error', err). End/error release this stream's admission slot (the
+        caller still owns close() for early abandonment)."""
+        kind, payload = self._gen.poll()
+        if kind == "end":
+            self._release()
+        elif kind == "error":
+            from ray_tpu.core.worker import ActorDiedError
+
+            if isinstance(payload, ActorDiedError):
+                # No mid-stream retry (items may already be delivered), but
+                # the membership refresh must still happen.
+                self._rs.fail_over(self._name)
+            self._release()
+        return kind, payload
+
     def close(self):
         """Stop consuming: cancels the replica-side generator task (its next
         yield observes the close and the user generator is closed), then

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import functools
 import json
 import socket
 import threading
@@ -73,6 +74,19 @@ def _encode_chunk(chunk) -> bytes:
     return json.dumps(chunk).encode() + b"\n"
 
 
+class _StreamBody:
+    """Streaming response source: the handle's DeploymentResponseGenerator
+    plus the already-consumed first item. Consumed by _stream_on_loop via
+    the generator's arm_async()/poll() surface — drained on the proxy's own
+    event loop, no dedicated pump thread, no per-chunk sync-queue handoff."""
+
+    def __init__(self, gen, first, app: str = "", deployment: str = ""):
+        self.gen = gen  # DeploymentResponseGenerator of proxy-tagged items
+        self.first = first
+        self.app = app
+        self.deployment = deployment
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -106,6 +120,22 @@ class ProxyActor:
         self._routes: dict[str, tuple[str, str]] = {}
         self._routes_at = 0.0
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=32, thread_name_prefix="proxy")
+        # Streaming data-plane metrics (reporter -> controller -> /metrics):
+        # how many items each chunked-transfer write coalesced, and the item
+        # rate of the last completed stream per deployment.
+        from ray_tpu.util import metrics as _metrics
+
+        self._stream_batch = _metrics.Histogram(
+            "serve.stream.batch_size",
+            "items coalesced per chunked-transfer write",
+            boundaries=[1, 2, 4, 8, 16, 32, 64],
+            tag_keys=("app", "deployment"),
+        )
+        self._stream_rate = _metrics.Gauge(
+            "serve.stream.items_per_s",
+            "streamed items per second over the last completed stream",
+            tag_keys=("app", "deployment"),
+        )
         self._loop = asyncio.new_event_loop()
         self._ready = threading.Event()
         self._thread = threading.Thread(target=self._serve, name="serve-proxy", daemon=True)
@@ -267,10 +297,13 @@ class ProxyActor:
                 pass
 
     async def _write_streaming(self, writer: asyncio.StreamWriter, resp):
-        """Write an HTTP/1.1 chunked-transfer response, pulling each chunk
-        from the (blocking) stream iterator on the thread pool so the accept
-        loop never stalls (reference: proxy.py:710 ASGI streaming — first
-        byte reaches the client as soon as the replica yields it)."""
+        """Write an HTTP/1.1 chunked-transfer response. Handle streams
+        (_StreamBody) drain on THIS loop: the stream's arrival wakeups set
+        an asyncio.Event, every wake drains ALL available items, and the
+        drained run ships as ONE chunked-transfer write — no pump thread,
+        no per-chunk sync-queue handoff, adjacent chunks coalesced per tick.
+        Plain iterators keep the legacy pump-thread path (a blocking
+        iterator must never stall the accept loop)."""
         status, chunks, ctype, _ = resp
         head = (
             f"HTTP/1.1 {status}\r\ncontent-type: {ctype}\r\n"
@@ -278,6 +311,11 @@ class ProxyActor:
         )
         writer.write(head.encode())
         await writer.drain()
+        if isinstance(chunks, _StreamBody):
+            await self._stream_on_loop(writer, chunks)
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+            return
         # One dedicated pump thread per stream (NOT the shared dispatch pool:
         # a slow token stream blocks its puller for the stream's lifetime, and
         # N concurrent streams on the shared pool would starve dispatch and
@@ -334,6 +372,69 @@ class ProxyActor:
                 q.get_nowait()
         writer.write(b"0\r\n\r\n")
         await writer.drain()
+
+    async def _stream_on_loop(self, writer: asyncio.StreamWriter, body: "_StreamBody"):
+        """Drain a handle stream on the proxy loop. Each drain cycle frames
+        every available item and ships them in one write + one drain;
+        between cycles the loop is free for other connections. Item values
+        resolve via the owner's thread-safe local fast path (streamed chunks
+        are inline objects already absorbed by the time their refs surface);
+        only a miss (large shm item) pays an executor-thread get."""
+        import ray_tpu as rt
+        from ray_tpu.core import api as _api
+        from ray_tpu.core.worker import _MISS
+
+        core = _api._require_worker()
+        gen = body.gen
+        ev = gen.arm_async(self._loop)
+        tags = {"app": body.app, "deployment": body.deployment}
+        t0 = time.perf_counter()
+        total_items = 1  # the first item was consumed by the router
+        pending: list[bytes] = []
+        data = _encode_chunk(body.first)
+        if data:
+            pending.append(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        try:
+            done = False
+            while True:
+                # Clear BEFORE polling: a push landing between the last poll
+                # and the wait re-sets the event, so no arrival is lost.
+                ev.clear()
+                while True:
+                    kind, payload = gen.poll()
+                    if kind == "wait":
+                        break
+                    if kind in ("end", "error"):
+                        # error: everything already delivered stays delivered;
+                        # the chunked body terminates (same as the pump path).
+                        done = True
+                        break
+                    value = core._try_local_value(payload)
+                    if value is _MISS:
+                        value = await self._loop.run_in_executor(
+                            self._pool, functools.partial(rt.get, payload, timeout=60)
+                        )
+                    if isinstance(value, tuple) and len(value) == 2:
+                        value = value[1]  # replica proxy-tags items ('chunk', x)
+                    data = _encode_chunk(value)
+                    if data:
+                        pending.append(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                    total_items += 1
+                if pending:
+                    self._stream_batch.observe(len(pending), tags=tags)
+                    writer.write(b"".join(pending))
+                    pending.clear()
+                    await writer.drain()
+                if done:
+                    return
+                await ev.wait()
+        except Exception:
+            pass  # client gone / item resolution failed: terminate the body
+        finally:
+            gen.disarm_async()
+            gen.close()  # idempotent: cancels the producer, frees admission
+            elapsed = max(time.perf_counter() - t0, 1e-9)
+            self._stream_rate.set(round(total_items / elapsed, 1), tags=tags)
 
     def _dispatch(self, method: str, target: str, headers: dict, body: bytes):
         """Entry for every HTTP request (thread pool). Tracing: a ROOT span
@@ -443,13 +544,4 @@ class ProxyActor:
             ctype = "application/octet-stream"
         else:
             ctype = "application/x-ndjson"
-
-        def chunk_iter():
-            try:
-                yield first
-                for tag_i, item in gen:
-                    yield item
-            finally:
-                gen.close()
-
-        return "200 OK", chunk_iter(), ctype, True
+        return "200 OK", _StreamBody(gen, first, app, deployment), ctype, True

@@ -538,6 +538,41 @@ def test_counted_trims_deque_maxlen():
     assert "counted-trims" not in _rules_hit(unbounded)
 
 
+def test_counted_trims_deque_positional_maxlen():
+    """maxlen passed positionally — deque(iterable, N) — bounds the buffer
+    exactly like the keyword form and must not slip past the rule (coverage
+    gap found reviewing the streaming fast lane's bounded buffer)."""
+    silent = _lint("""
+        from collections import deque
+
+        class Buf:
+            def __init__(self):
+                self.recent = deque([], 128)
+    """)
+    assert "counted-trims" in _rules_hit(silent)
+    counted = _lint("""
+        from collections import deque
+
+        class Buf:
+            def __init__(self):
+                self.recent = deque([], 128)
+
+            def add(self, x):
+                if len(self.recent) == self.recent.maxlen:
+                    self.recent_dropped += 1
+                self.recent.append(x)
+    """)
+    assert "counted-trims" not in _rules_hit(counted)
+    # deque(iterable) alone and an explicit positional None stay unbounded.
+    unbounded = _lint("""
+        from collections import deque
+
+        a = deque(range(3))
+        b = deque([], None)
+    """)
+    assert "counted-trims" not in _rules_hit(unbounded)
+
+
 def test_counted_trims_fires_outside_functions_too():
     module_level = _lint("""
         CACHE = {}
