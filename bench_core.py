@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -315,6 +316,120 @@ def bench_streaming_items(n):
     )
 
 
+# QoS overload A/B (detail in the ON row): interleaved arms at ~3x offered
+# load against a capacity-bounded serve app. Goodput is CLIENT-measured —
+# interactive requests that returned 200 within their 0.5s budget — so the
+# two arms are comparable even though only the ON arm sheds/expires
+# server-side. The OFF arm runs first in its OWN session with
+# Config.qos_enabled=False propagated cluster-wide (the proxy process reads
+# it at actor creation), exactly like the state-introspection A/B.
+_QOS_AB: dict = {}
+
+_GOODPUT_BUDGET_S = 0.5
+
+
+def _overload_goodput_arm(duration_s: float) -> dict:
+    import urllib.error
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment(name="Bench", max_ongoing_requests=2)
+    class Bench:
+        def __call__(self, request):
+            time.sleep(0.05)  # fixed 50ms service: capacity = 2/0.05 = 40 rps
+            return "ok"
+
+    serve.run(Bench.bind(), name="goodput", route_prefix="/goodput")
+    port = serve.http_port()
+
+    def one(headers: dict) -> tuple:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/goodput", data=b"{}", method="POST",
+            headers=headers,
+        )
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                code = resp.status
+                resp.read()
+        except urllib.error.HTTPError as e:
+            code = e.code
+            e.read()
+        except Exception:
+            # URLError / socket timeout must not kill a load thread — a
+            # dead thread silently deflates that arm's goodput and skews
+            # the A/B (same contract as the chaos scenario's hit()).
+            code = -1
+        return code, time.perf_counter() - t0
+
+    # Quiet path (no overload, DEFAULT context — no QoS headers): the cost
+    # of the plane's structural pieces alone; must be within noise of OFF.
+    quiet = sorted(one({})[1] for _ in range(20))
+    quiet_ms = quiet[len(quiet) // 2] * 1e3
+
+    stop_at = time.monotonic() + duration_s
+    lock = threading.Lock()
+    inter: list = []  # (code, latency) per interactive request
+    shed = [0]
+
+    def flood(headers: dict, sink: list | None, think_s: float):
+        while time.monotonic() < stop_at:
+            code, lat = one(headers)
+            with lock:
+                if sink is not None:
+                    sink.append((code, lat))
+                if code == 429:
+                    shed[0] += 1
+            if think_s:
+                time.sleep(think_s)
+
+    threads = (
+        [threading.Thread(target=flood,
+                          args=({"x-priority": "best_effort", "x-tenant": f"bg{i % 2}"},
+                                None, 0.0))
+         for i in range(16)]
+        + [threading.Thread(target=flood,
+                            args=({"x-priority": "interactive", "x-tenant": "user"},
+                                  inter, 0.02))
+           for _ in range(3)]
+    )
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 120)
+    good = sum(1 for code, lat in inter if code == 200 and lat <= _GOODPUT_BUDGET_S)
+    lats = sorted(lat for _, lat in inter) or [0.0]
+    out = {
+        "interactive_total": len(inter),
+        "goodput_rps": round(good / duration_s, 1),
+        "interactive_p99_s": round(lats[min(len(lats) - 1, int(len(lats) * 0.99))], 3),
+        "sheds_429": shed[0],
+        "quiet_ms": round(quiet_ms, 2),
+    }
+    serve.shutdown()
+    return out
+
+
+def bench_overload_goodput_off(_n):
+    _QOS_AB["off"] = _overload_goodput_arm(3.0 if QUICK else 6.0)
+
+
+def bench_overload_goodput(_n):
+    duration = 3.0 if QUICK else 6.0
+    on = _overload_goodput_arm(duration)
+    off = _QOS_AB.get("off") or {}
+    detail = {"on": on}
+    if off:
+        detail["off"] = off
+        detail["goodput_x"] = round(
+            on["goodput_rps"] / max(off["goodput_rps"], 0.1), 2)
+        detail["quiet_overhead_pct"] = round(
+            (on["quiet_ms"] / max(off["quiet_ms"], 1e-6) - 1.0) * 100.0, 2)
+    report("overload_goodput", on["goodput_rps"] * duration, duration,
+           unit="interactive req/s in budget", detail=detail)
+
+
 def bench_get_calls(n):
     ref = rt.put(b"x" * 1024)
 
@@ -456,6 +571,8 @@ def main():
         (bench_tasks_sync, int(500 * SCALE)),
         (bench_tasks_async, int(2000 * SCALE)),
         (bench_streaming_items, int(3000 * SCALE)),
+        (bench_overload_goodput_off, 1),
+        (bench_overload_goodput, 1),
         (bench_get_calls, int(3000 * SCALE)),
         (bench_put_calls, int(3000 * SCALE)),
         (bench_put_gigabytes, int(512 * 1024 * 1024 * SCALE)),
@@ -474,14 +591,17 @@ def main():
 
     for fn, n in benches:
         # The state A/B's OFF arm disables lifecycle events for its whole
-        # session (head config propagates to workers at registration).
+        # session (head config propagates to workers at registration); the
+        # QoS A/B's OFF arm disables adaptive admission the same way.
         get_config().task_events_enabled = fn is not bench_tasks_sync_state_off
+        get_config().qos_enabled = fn is not bench_overload_goodput_off
         rt.init(num_cpus=ncpu, object_store_memory=512 * 1024 * 1024)
         try:
             fn(n)
         finally:
             rt.shutdown()
             get_config().task_events_enabled = True
+            get_config().qos_enabled = True
     with open("BENCH_CORE.json", "w") as f:
         json.dump(RESULTS, f, indent=1)
 

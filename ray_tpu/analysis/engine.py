@@ -210,7 +210,7 @@ def default_rules() -> list:
         LoopThreadRace,
         NoBlockingInAsync,
     )
-    from ray_tpu.analysis.rules_buffers import CountedTrims
+    from ray_tpu.analysis.rules_buffers import CountedSheds, CountedTrims
     from ray_tpu.analysis.rules_chaos import ChaosGate
     from ray_tpu.analysis.rules_fsm import FsmEmitter
     from ray_tpu.analysis.rules_security import MacBeforePickle
@@ -220,6 +220,7 @@ def default_rules() -> list:
         NoBlockingInAsync(),
         MacBeforePickle(),
         CountedTrims(),
+        CountedSheds(),
         LoopThreadRace(),
         FsmEmitter(),
         ChaosGate(),

@@ -1,8 +1,20 @@
-"""counted-trims: every bounded eviction must increment a dropped/evicted
+"""counted-trims + counted-sheds: nothing is discarded silently.
+
+counted-trims: every bounded eviction must increment a dropped/evicted
 counter — the "no silent caps" rule (PRs 2/4: every silently-trimmed buffer
 was eventually a debugging session; raytpu_events_dropped_total{where} and
 the tasks_evicted/traces_evicted counters exist because data that vanishes
 untallied reads as "never happened").
+
+counted-sheds extends the same ethos to the QoS plane's REQUEST drops: any
+code path that rejects or expires a request (a direct
+``raise DeadlineExceeded(...)``, or a function implementing shedding — a
+``shed`` name segment) must increment a ``*_shed``/``*_expired``/
+``*_dropped`` counter in the same scope. An uncounted rejection is a user
+request that vanished: under overload — exactly when you are debugging —
+the metrics would claim traffic that never existed. The sanctioned pattern
+is ``qos.raise_expired(hop)`` (which counts inside), so direct raises
+outside ray_tpu/qos/ are rare and must carry their own tally.
 
 Detected trim shapes:
   * slice deletes            ``del self.events[:trimmed]``
@@ -169,4 +181,101 @@ class CountedTrims(Rule):
                 "deque(maxlen=...) discards silently on append — increment a "
                 "*_dropped/*_evicted counter on the discard path (none found "
                 "in this scope)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# counted-sheds
+# ---------------------------------------------------------------------------
+
+_SHED_COUNTER_MARKERS = ("shed", "expired", "dropped", "evicted", "rejected")
+
+
+def _is_reject_tally_name(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in _SHED_COUNTER_MARKERS)
+
+
+def _implements_shedding(name: str) -> bool:
+    """"shed" as an UNDERSCORE-DELIMITED segment — substring matching would
+    drag in "finished"/"watershed"-shaped names."""
+    return "shed" in name.lower().split("_")
+
+
+class _ShedRegion:
+    __slots__ = ("node", "sheds", "counted")
+
+    def __init__(self, node):
+        self.node = node
+        self.sheds: list = []  # ((line, end_line), what)
+        self.counted = False
+
+
+class CountedSheds(Rule):
+    id = "counted-sheds"
+    explanation = (
+        "request drop/reject path with no *_shed/*_expired/*_dropped counter "
+        "in scope — an uncounted rejection is a request that silently vanished"
+    )
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._module = _ShedRegion(None)
+        self._funcs: list = []
+
+    def _region(self) -> "_ShedRegion":
+        return self._funcs[-1] if self._funcs else self._module
+
+    def _mark_counted(self) -> None:
+        self._region().counted = True
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            region = _ShedRegion(node)
+            if _implements_shedding(node.name):
+                # A function IMPLEMENTING shedding must tally what it sheds.
+                region.sheds.append(
+                    ((node.lineno, node.lineno), f"shed path {node.name}()")
+                )
+            self._funcs.append(region)
+            return
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            dn = dotted_name(node.target)
+            if dn and _is_reject_tally_name(dn.rsplit(".", 1)[-1]):
+                self._mark_counted()
+            return
+        if isinstance(node, ast.Raise):
+            exc = node.exc
+            callee = ""
+            if isinstance(exc, ast.Call):
+                fn = exc.func
+                callee = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else ""
+                )
+            if callee == "DeadlineExceeded":
+                self._region().sheds.append((_span(node), "raise DeadlineExceeded"))
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "inc":
+                obj = dotted_name(fn.value)
+                if _is_reject_tally_name(obj):
+                    self._mark_counted()
+
+    def leave(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and self._funcs:
+            self._flush(self._funcs.pop(), ctx)
+
+    def end_file(self, ctx: FileContext) -> None:
+        self._flush(self._module, ctx)
+
+    def _flush(self, region: "_ShedRegion", ctx: FileContext) -> None:
+        if region.counted:
+            return
+        for span, what in region.sheds:
+            ctx.report(
+                self,
+                span,
+                f"{what} with no shed/expired/dropped counter incremented in "
+                "the same scope — count every rejected request (or go through "
+                "qos.raise_expired, which does)",
             )

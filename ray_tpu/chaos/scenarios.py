@@ -355,12 +355,201 @@ def _scn_tpu_preempt_drain(seed: int, quick: bool) -> dict:
     }
 
 
+def _scn_overload_storm(seed: int, quick: bool) -> dict:
+    """Sustained ~3x overload against a capacity-bounded serve app whose
+    per-request exec delay is chaos-injected (site serve.replica.slow): the
+    QoS plane must hold interactive goodput while shedding/expiring the
+    background classes. Invariants pinned here, beyond the standard battery:
+
+    * interactive goodput stays high (>= 90% success) and its p99 bounded;
+    * EVERY rejection is visible — observed 429s == the proxy's
+      serve.request.shed_total, observed 504s == serve.request.expired_total
+      (both read from the controller's merged /metrics view);
+    * NO deadline-expired request ever reached user code: the deployment's
+      own invocation count equals the number of 200s, and the
+      qos.exec.expired_total tripwire is zero.
+    """
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import ray_tpu as rt
+    from ray_tpu.core.api import Cluster, init
+
+    cfg = _fresh_config()
+    # Tight AIMD knobs so the limit converges inside the scenario window.
+    cfg.qos_target_delay_s = 0.08
+    cfg.qos_min_concurrency = 2
+    cfg.qos_initial_concurrency = 8
+    cfg.qos_adapt_interval_s = 0.25
+    cfg.chaos_spec = json.dumps({
+        "seed": seed,
+        "rules": [{"site": "serve.replica.slow", "kind": "delay",
+                   "delay_s": 0.04, "ctx": {"deployment": "Slowpoke"}}],
+    })
+    _plan.install_from_json(cfg.chaos_spec)
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=4)
+    init(address=cluster.address, config=cfg)
+    from ray_tpu import serve
+
+    @serve.deployment(name="Slowpoke", max_ongoing_requests=2)
+    class Slowpoke:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.invoked = 0
+
+        def __call__(self, request):
+            with self._lock:
+                self.invoked += 1
+            return "ok"
+
+        def count(self):
+            with self._lock:
+                return self.invoked
+
+    serve.run(Slowpoke.bind(), name="storm", route_prefix="/storm")
+    port = serve.http_port()
+
+    # Baseline the QoS counters BEFORE the load: the driver's metric
+    # registry is process-global and may carry counts from earlier sessions
+    # in the same process (e.g. a test suite) — the exact-accounting
+    # assertions below are on DELTAS.
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+
+    def _metric_sum(series, name, tag=None):
+        return sum(
+            rec.get("value", 0.0) for rec in series
+            if rec.get("name") == name
+            and (tag is None or all(rec.get("tags", {}).get(k) == v for k, v in tag.items()))
+        )
+
+    core._run(core._report_metrics())
+    series0 = core._run(core.controller.call("get_metrics", {}))
+    shed0 = _metric_sum(series0, "serve.request.shed_total")
+    expired0 = _metric_sum(series0, "serve.request.expired_total")
+    tripwire0 = _metric_sum(series0, "qos.exec.expired_total")
+
+    duration = 4.0 if quick else 7.0
+    stop_at = time.monotonic() + duration
+    lock = threading.Lock()
+    stats: dict = {}  # class -> {status -> n}
+    lat: dict = {"interactive": []}
+
+    def hit(klass: str, tenant: str, timeout_s: float):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/storm", data=b"{}", method="POST",
+            headers={"x-priority": klass, "x-tenant": tenant,
+                     "x-request-timeout-s": str(timeout_s)},
+        )
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                code = resp.status
+                resp.read()
+        except urllib.error.HTTPError as e:
+            code = e.code
+            e.read()
+        except Exception:
+            code = -1
+        elapsed = time.perf_counter() - t0
+        with lock:
+            per = stats.setdefault(klass, {})
+            per[code] = per.get(code, 0) + 1
+            if klass == "interactive":
+                lat["interactive"].append(elapsed)
+
+    def flood(klass: str, tenant: str, timeout_s: float, think_s: float):
+        while time.monotonic() < stop_at:
+            hit(klass, tenant, timeout_s)
+            if think_s:
+                time.sleep(think_s)
+
+    threads = (
+        # Background: two tenants of best_effort flood + one batch lane —
+        # the overload the plane must shed.
+        [threading.Thread(target=flood, args=("best_effort", f"bg{i % 2}", 1.0, 0.0))
+         for i in range(6)]
+        + [threading.Thread(target=flood, args=("batch", "etl", 1.5, 0.0))
+           for _ in range(2)]
+        # Foreground: the interactive trickle whose goodput is protected.
+        + [threading.Thread(target=flood, args=("interactive", "user", 2.0, 0.05))
+           for _ in range(2)]
+    )
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 60)
+    _require(all(not t.is_alive() for t in threads), "load threads wedged")
+
+    inter = stats.get("interactive", {})
+    n_inter = sum(inter.values())
+    ok_inter = inter.get(200, 0)
+    _require(n_inter > 0, "no interactive request ever completed a round trip")
+    _require(ok_inter / n_inter >= 0.9,
+             f"interactive goodput collapsed under overload: {inter}")
+    lats = sorted(lat["interactive"])
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+    _require(p99 < 1.5, f"interactive p99 unbounded: {p99:.3f}s")
+    shed_observed = sum(per.get(429, 0) for per in stats.values())
+    expired_observed = sum(per.get(504, 0) for per in stats.values())
+    _require(shed_observed >= 1,
+             f"overload never shed anything — the admission controller is dead: {stats}")
+    _require(sum(per.get(-1, 0) + per.get(500, 0) for per in stats.values()) == 0,
+             f"hard failures under overload: {stats}")
+
+    # -- exact shed/expiry accounting on the merged /metrics view ---------
+    deadline = time.monotonic() + 12
+    shed_metric = expired_metric = tripwire = -1.0
+    while time.monotonic() < deadline:
+        core._run(core._report_metrics())
+        series = core._run(core.controller.call("get_metrics", {}))
+        shed_metric = _metric_sum(series, "serve.request.shed_total") - shed0
+        expired_metric = _metric_sum(series, "serve.request.expired_total") - expired0
+        tripwire = _metric_sum(series, "qos.exec.expired_total") - tripwire0
+        if shed_metric >= shed_observed and expired_metric >= expired_observed:
+            break
+        time.sleep(0.4)
+    _require(shed_metric == shed_observed,
+             f"shed accounting broken: {shed_metric} on /metrics vs {shed_observed} observed 429s")
+    _require(expired_metric == expired_observed,
+             f"expiry accounting broken: {expired_metric} on /metrics vs {expired_observed} observed 504s")
+    _require(tripwire == 0.0,
+             f"{tripwire:.0f} expired requests began executing — a deadline gate was bypassed")
+
+    # -- no expired/shed request ever reached user code -------------------
+    h = serve.get_deployment_handle("Slowpoke", "storm")
+    invoked = h.options(method_name="count").remote().result(timeout=30)
+    total_200 = sum(per.get(200, 0) for per in stats.values())
+    _require(invoked == total_200,
+             f"replica invoked user code {invoked}x but only {total_200} requests "
+             "succeeded — a shed or expired request reached the callable")
+    from ray_tpu.serve.handle import _reset_registry
+
+    _reset_registry()  # park router threads before the invariant battery
+    return {
+        "cluster": cluster,
+        "details": {
+            "stats": {k: {str(c): n for c, n in per.items()} for k, per in stats.items()},
+            "interactive_p99_s": round(p99, 3),
+            "shed": shed_observed, "expired": expired_observed,
+            "invoked": invoked,
+        },
+        # Every invocation rode one injected serve.replica.slow delay.
+        "min_injections": 0,  # injections happen in the REPLICA process, not here
+        "min_metric_injections": 1,
+    }
+
+
 SCENARIOS: dict = {
     "worker_kill": _scn_worker_kill,
     "pull_source_death": _scn_pull_source_death,
     "controller_restart": _scn_controller_restart,
     "mac_corrupt_storm": _scn_mac_corrupt_storm,
     "tpu_preempt_drain": _scn_tpu_preempt_drain,
+    "overload_storm": _scn_overload_storm,
 }
 
 

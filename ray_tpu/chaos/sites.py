@@ -100,6 +100,17 @@ SITES: dict = {
         "desc": "an actor method call about to execute",
         "exercises": "actor call failure/latency; caller-side reply handling",
     },
+    # -- L4: serve data plane ---------------------------------------------
+    "serve.replica.slow": {
+        "layer": "serve",
+        "kinds": {"delay"},
+        "desc": "one request about to execute on a serve replica (injected "
+                "per-request exec delay, after the deadline gate)",
+        "exercises": "QoS plane under slow replicas: fair-queue buildup, "
+                     "queue-delay-driven AIMD shedding at the proxy, deadline "
+                     "expiry at every hop, interactive goodput under overload "
+                     "(scenario overload_storm)",
+    },
     # -- L1: controller ---------------------------------------------------
     "controller.heartbeat": {
         "layer": "controller",

@@ -121,6 +121,21 @@ class Config:
     # Per-task state index bound on the controller ((task_id, attempt)
     # records); overflow evicts terminal-first and counts tasks_evicted.
     task_index_size: int = 8192
+    # --- QoS / overload protection (serve proxy + handle; ray_tpu/qos) ---
+    # Master switch for the proxy's ADAPTIVE ADMISSION (AIMD concurrency
+    # limit + class-tiered shedding with 429s). Off, the proxy admits
+    # everything — the plane-OFF baseline for the overload_goodput bench.
+    # The fair admission queue and deadline gates are structural (always on:
+    # with no RequestContext they cost one ContextVar.get per hop).
+    qos_enabled: bool = True
+    # CoDel-style queue-delay target: if even the window's MINIMUM observed
+    # handle-admission delay exceeds this, a standing queue exists and the
+    # limit backs off multiplicatively; otherwise it probes up additively.
+    qos_target_delay_s: float = 0.1
+    qos_min_concurrency: int = 4
+    qos_max_concurrency: int = 1024
+    qos_initial_concurrency: int = 64
+    qos_adapt_interval_s: float = 0.5
     # --- chaos (deterministic fault injection; see ray_tpu/chaos/) ---
     # JSON FaultSchedule spec ({"seed": N, "rules": [...]}) armed in EVERY
     # process of the session: the head pushes it with the rest of the config

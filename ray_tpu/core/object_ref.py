@@ -142,6 +142,12 @@ class ObjectRefGenerator:
             if total is not None:
                 self._total = total
             if error is not None:
+                # Same contract as rt.get (worker.get_sync): a RemoteError
+                # carrying a picklable cause re-raises the TYPED original —
+                # a streamed DeadlineExceeded must reach the consumer as
+                # DeadlineExceeded, not as a generic RemoteError wrapper.
+                cause = getattr(error, "cause", None)
+                error = cause if cause is not None else error
                 self._error = error
                 if self._total is None:
                     # Hand out what already arrived, then raise.

@@ -85,6 +85,10 @@ class TaskSpec:
     # submission, or None (the overwhelmingly common case). Rides the pickled
     # spec / lean-frame payload — no wire-version bump (util/tracing.py).
     trace_ctx: Optional[tuple] = None
+    # QoS: the caller's active (rank, tenant, deadline, rid) at submission,
+    # or None. Same propagation scheme as trace_ctx (pickled spec / the
+    # lean-frame "qc" key) — see ray_tpu/qos/context.py.
+    qos_ctx: Optional[tuple] = None
 
     @property
     def is_actor_task(self) -> bool:

@@ -54,6 +54,7 @@ from ray_tpu.core.object_store import MemoryStore, ObjectExistsError, ObjectStor
 from ray_tpu.core.serialization import RemoteError
 from ray_tpu.core import task_state as _ts
 from ray_tpu.core.task_spec import ActorSpec, TaskOptions, TaskSpec, scheduling_key
+from ray_tpu.qos import context as _qos
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
 from ray_tpu.util.bgtasks import spawn_bg as _spawn_bg_task
@@ -281,6 +282,8 @@ class _KeySubmitter:
                     )}
                     if spec.trace_ctx is not None:
                         msg["tc"] = spec.trace_ctx
+                    if spec.qos_ctx is not None:
+                        msg["qc"] = spec.qos_ctx
                     wire.append(msg)
             for spec, _ in items:
                 # FSM: the attempt left the submitter queue for a concrete
@@ -1681,6 +1684,7 @@ class CoreWorker:
             options=opts,
             caller_addr=self.address,
             trace_ctx=_tracing.current_trace(),  # None unless a span is active
+            qos_ctx=_qos.current_wire(),  # None unless a request context is active
         )
         gen = ObjectRefGenerator(task_id, self.address) if streaming else None
         if gen is not None:
@@ -1902,7 +1906,7 @@ class CoreWorker:
         spec = TaskSpec(
             task_id=TaskID(tid), job_id=job_id, fn_id=fn_id, args_blob=args_blob,
             num_returns=num_returns, options=options, caller_addr=caller_addr,
-            trace_ctx=p.get("tc"),
+            trace_ctx=p.get("tc"), qos_ctx=p.get("qc"),
         )
         if attempt:
             spec._attempts = attempt  # type: ignore[attr-defined] - retried attempt: exec events key the same index record
@@ -1929,6 +1933,11 @@ class CoreWorker:
                                  span_id=spec._exec_ctx[1])
             t0 = time.monotonic()
             try:
+                # QoS hop "worker": an already-expired request is dropped
+                # HERE, before user code — the typed error reply rides the
+                # normal error path back to the caller (counted, traced).
+                _qos.check_deadline("worker", _qos.from_wire(spec.qos_ctx),
+                                    detail=_spec_fn_name(spec))
                 fault = _chaos.maybe_inject("worker.exec", fn=_spec_fn_name(spec))
                 if fault is not None:
                     if fault.kind == "kill":
@@ -1980,6 +1989,7 @@ class CoreWorker:
             # Context active for the generator BODY too (it runs during the
             # next() calls below, not inside _execute_task's window).
             token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+            qtoken = _qos.activate(spec.qos_ctx)
             try:
                 out = self._execute_task(fn, spec)
                 if not inspect.isgenerator(out):
@@ -1998,6 +2008,7 @@ class CoreWorker:
                 shipper.finish()
                 return count
             finally:
+                _qos.deactivate(qtoken)
                 _tracing.deactivate(token)
 
         # Stream state registered/cleaned by handle_push_task's try/finally.
@@ -2062,12 +2073,17 @@ class CoreWorker:
         kwargs = {k: (self.get_sync(v) if isinstance(v, ObjectRef) else v) for k, v in kwargs.items()}
         self._current_task = spec
         # Executor threads don't inherit the IO loop's contextvars: install
-        # the task's execution span (if traced) so user-code spans and nested
-        # submissions chain onto it.
+        # the task's execution span (if traced) and QoS context so user-code
+        # spans, nested submissions, and deadline checks chain onto them.
         token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+        qtoken = _qos.activate(spec.qos_ctx)
+        # Tripwire: user code entering with a LONG-expired deadline means a
+        # gate was bypassed (qos.exec.expired_total; grace for jitter).
+        _qos.mark_exec_start("worker")
         try:
             return fn(*args, **kwargs)
         finally:
+            _qos.deactivate(qtoken)
             _tracing.deactivate(token)
             self._current_task = None
 
@@ -2141,6 +2157,7 @@ class CoreWorker:
             method_name=method,
             concurrency_group=concurrency_group,
             trace_ctx=tc,
+            qos_ctx=_qos.current_wire(),
         )
         refs = [] if streaming else [
             ObjectRef(ObjectID.for_return(task_id, i), self.address, _register=False) for i in range(n_returns)
@@ -2300,6 +2317,8 @@ class CoreWorker:
                     )}
                     if spec.trace_ctx is not None:
                         payload["tc"] = spec.trace_ctx
+                    if spec.qos_ctx is not None:
+                        payload["qc"] = spec.qos_ctx
                 sent.append((spec, entry["conn"].call_start("push_actor_task", payload)))
             # Backpressure: bound the transport buffer before the next drain.
             await entry["conn"].flush()
@@ -2496,7 +2515,7 @@ class CoreWorker:
                 task_id=TaskID(tid), job_id=job_id, fn_id="", args_blob=args_blob,
                 num_returns=num_returns, options=options, caller_addr=caller_addr,
                 actor_id=actor_id, method_name=method, concurrency_group=cg,
-                trace_ctx=p.get("tc"),
+                trace_ctx=p.get("tc"), qos_ctx=p.get("qc"),
             )
         streaming = spec.num_returns == -1
         if streaming:
@@ -2730,6 +2749,11 @@ class ActorRuntime:
                 "error": RemoteError.from_exception(AttributeError(f"no method {spec.method_name}"), "actor task"),
             }
         try:
+            # QoS hop "worker" (actor lane): drop already-expired calls
+            # before the method runs; the typed error reply reaches the
+            # caller through the normal error path (counted, traced).
+            _qos.check_deadline("worker", _qos.from_wire(spec.qos_ctx),
+                                detail=spec.method_name)
             fault = _chaos.maybe_inject("worker.actor.exec", method=spec.method_name)
             if fault is not None:
                 if fault.kind == "delay":
@@ -2765,6 +2789,7 @@ class ActorRuntime:
             args, kwargs = await loop.run_in_executor(None, self._resolve, spec.args_blob)
             count = 0
             token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+            qtoken = _qos.activate(spec.qos_ctx)
             try:
                 async with sem:
                     agen = method(*args, **kwargs)
@@ -2780,11 +2805,13 @@ class ActorRuntime:
                 await shipper.afinish()
                 return count
             finally:
+                _qos.deactivate(qtoken)
                 _tracing.deactivate(token)
 
         def run():
             # Context active for the generator BODY (runs during next()).
             token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+            qtoken = _qos.activate(spec.qos_ctx)
             try:
                 out = self._call_sync(method, spec)
                 if not inspect.isgenerator(out):
@@ -2803,6 +2830,7 @@ class ActorRuntime:
                 shipper.finish()
                 return n
             finally:
+                _qos.deactivate(qtoken)
                 _tracing.deactivate(token)
 
         # Stream state registered/cleaned by handle_push_actor_task's
@@ -2818,19 +2846,26 @@ class ActorRuntime:
     def _call_sync(self, method, spec: TaskSpec):
         args, kwargs = self._resolve(spec.args_blob)
         # Pool threads don't inherit the IO loop's contextvars: install the
-        # call's execution span (if traced) so user code chains onto it.
+        # call's execution span (if traced) + QoS context so user code
+        # chains onto them.
         token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+        qtoken = _qos.activate(spec.qos_ctx)
+        _qos.mark_exec_start("worker")
         try:
             return method(*args, **kwargs)
         finally:
+            _qos.deactivate(qtoken)
             _tracing.deactivate(token)
 
     async def _call_async(self, method, spec: TaskSpec):
         args, kwargs = await asyncio.get_running_loop().run_in_executor(None, self._resolve, spec.args_blob)
         token = _tracing.activate(getattr(spec, "_exec_ctx", None))
+        qtoken = _qos.activate(spec.qos_ctx)
+        _qos.mark_exec_start("worker")
         try:
             return await method(*args, **kwargs)
         finally:
+            _qos.deactivate(qtoken)
             _tracing.deactivate(token)
 
     def on_exit(self):

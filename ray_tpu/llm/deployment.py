@@ -114,10 +114,24 @@ class LLMServer:
                  sampling=None) -> dict:
         """Blocking generate; safe to call from many router threads at once —
         the engine batches all in-flight requests per decode iteration.
-        sampling: per-request SamplingParams (or kwargs dict for one)."""
+        sampling: per-request SamplingParams (or kwargs dict for one).
+
+        QoS: an active RequestContext caps the wait at the request's
+        deadline, and a caller that gave up (qos.cancel_requested(), fired
+        by the serve handle's cancel path) ABORTS the engine request — in
+        both cases the engine slot frees immediately instead of decoding
+        to completion for nobody."""
+        from ray_tpu.qos import context as _qos
         from ray_tpu.util import tracing as _tracing
 
         sampling = _coerce_sampling(sampling)
+        qctx = _qos.current()
+        rem = qctx.remaining() if qctx is not None else None
+        if rem is not None:
+            timeout_s = min(timeout_s, max(rem, 0.0))
+        cancellable = _qos.cancel_event() is not None
+        # Short wait slices only when there is a cancel/deadline to notice.
+        slice_s = 0.25 if (cancellable or rem is not None) else 1.0
         # child_span: free no-op unless the request arrived with a trace
         # (serve proxy/handle context rides the actor call into this thread).
         with _tracing.child_span("llm.generate", max_tokens=max_tokens):
@@ -127,10 +141,21 @@ class LLMServer:
                 self._cond.notify_all()
                 deadline = time.time() + timeout_s
                 while rid not in self._done:
+                    if cancellable and _qos.cancel_requested():
+                        self._aborts.add(rid)
+                        self._cond.notify_all()
+                        raise _qos.RequestCancelled(
+                            "caller abandoned generate(); engine slot freed")
                     remaining = deadline - time.time()
                     if remaining <= 0:
+                        # Free the slot: a timed-out request must not keep
+                        # decoding to completion (the orphaned-work bug).
+                        self._aborts.add(rid)
+                        self._cond.notify_all()
+                        if qctx is not None and qctx.expired():
+                            _qos.raise_expired("llm", "generate")
                         raise TimeoutError(f"generate timed out after {timeout_s}s")
-                    self._cond.wait(timeout=min(remaining, 1.0))
+                    self._cond.wait(timeout=min(remaining, slice_s))
                 return self._done.pop(rid)
 
     def generate_stream(self, tokens, max_tokens: int = 64, timeout_s: float = 300.0,
@@ -138,8 +163,20 @@ class LLMServer:
         """Streaming generate: yields one event dict per engine step that
         produced tokens for this request ({"new_tokens": [...], "ttft_s":
         float|None, "finished": bool}, final event carries "tokens"). Each
-        event leaves this replica the moment the decode block lands on host."""
+        event leaves this replica the moment the decode block lands on host.
+
+        QoS: the wait is capped at the request's deadline and a cancelled
+        caller aborts the engine request between yields (the finally already
+        aborts on early generator close)."""
+        from ray_tpu.qos import context as _qos
+
         sampling = _coerce_sampling(sampling)
+        qctx = _qos.current()
+        rem = qctx.remaining() if qctx is not None else None
+        if rem is not None:
+            timeout_s = min(timeout_s, max(rem, 0.0))
+        cancellable = _qos.cancel_event() is not None
+        slice_s = 0.25 if (cancellable or rem is not None) else 1.0
         with self._cond:
             rid = self._new_rid()
             self._streams[rid] = deque()
@@ -151,10 +188,15 @@ class LLMServer:
             while True:
                 with self._cond:
                     while not self._streams[rid]:
+                        if cancellable and _qos.cancel_requested():
+                            raise _qos.RequestCancelled(
+                                "caller abandoned generate_stream(); engine slot freed")
                         remaining = deadline - time.time()
                         if remaining <= 0:
+                            if qctx is not None and qctx.expired():
+                                _qos.raise_expired("llm", "generate_stream")
                             raise TimeoutError(f"generate timed out after {timeout_s}s")
-                        self._cond.wait(timeout=min(remaining, 1.0))
+                        self._cond.wait(timeout=min(remaining, slice_s))
                     ev = self._streams[rid].popleft()
                 out = {
                     "new_tokens": ev.get("new_tokens", []),

@@ -171,6 +171,40 @@ class OpenAIServer:
             status, json.dumps({"error": {"message": msg, "type": etype}})
         )
 
+    def _qos_scope(self, request, body: dict):
+        """Map the request's QoS fields into a RequestContext for the
+        generate call: ``x-priority`` / ``x-tenant`` / ``x-request-timeout-s``
+        headers (the proxy's convention) or, for handle/dict callers, the
+        body keys ``priority`` / ``tenant`` / ``timeout_s``. Inherits any
+        context already propagated from the proxy (request_context layers
+        over it); returns a no-op scope when nothing is specified."""
+        import contextlib
+
+        from ray_tpu import qos
+
+        headers = getattr(request, "headers", None) or {}
+        prio = (headers.get("x-priority") or body.get("priority") or "").strip().lower()
+        tenant = (headers.get("x-tenant") or body.get("tenant") or "").strip()
+        tmo = qos.parse_timeout_s(headers.get("x-request-timeout-s") or body.get("timeout_s"))
+        if not (prio or tenant or tmo > 0):
+            return contextlib.nullcontext()
+        deadline = None
+        if tmo > 0:
+            from ray_tpu.util import tracing as _tracing
+
+            deadline = _tracing.now() + tmo
+            cur = qos.current()
+            if cur is not None and cur.deadline is not None:
+                # The proxy already minted this request's deadline at INGRESS;
+                # re-deriving here would hand back the time already spent
+                # queued. A deadline only ever tightens downstream.
+                deadline = min(deadline, cur.deadline)
+        return qos.request_context(
+            priority=prio if prio in qos.PRIORITIES else None,
+            tenant=tenant or None,
+            deadline=deadline,
+        )
+
     def _sampling(self, body: dict) -> SamplingParams:
         return SamplingParams(
             temperature=float(body.get("temperature", self.default_temperature)),
@@ -242,9 +276,19 @@ class OpenAIServer:
         # Templated prompts already contain their special tokens.
         prompt_ids = self.tok.encode(prompt, add_bos=not templated)
         rid = f"{'chatcmpl' if is_chat else 'cmpl'}-{time.monotonic_ns():x}"
+        scope = self._qos_scope(request, body)
         if body.get("stream"):
-            return self._stream(rid, is_chat, prompt_ids, sp, stops)
-        return self._complete(rid, is_chat, prompt_ids, sp, stops, len(prompt_ids))
+            return self._stream_scoped(scope, rid, is_chat, prompt_ids, sp, stops)
+        with scope:
+            return self._complete(rid, is_chat, prompt_ids, sp, stops, len(prompt_ids))
+
+    def _stream_scoped(self, scope, rid, is_chat, prompt_ids, sp, stops):
+        """Generator wrapper keeping the QoS scope active for the STREAM's
+        whole body (the generator runs lazily, after __call__ returned —
+        a plain `with` in __call__ would reset the context before the first
+        token is generated)."""
+        with scope:
+            yield from self._stream(rid, is_chat, prompt_ids, sp, stops)
 
     # -- non-streaming -----------------------------------------------------
     def _complete(self, rid, is_chat, prompt_ids, sp, stops, n_prompt):

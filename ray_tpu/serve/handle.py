@@ -7,6 +7,14 @@ one with fewer ongoing requests). Departures, by design:
 - Admission control is fully client-side: the router tracks per-replica
   ongoing counts and never exceeds a replica's max_ongoing_requests; excess
   demand queues in the handle (the reference queues in the router too).
+- The admission queue is a WEIGHTED FAIR QUEUE (ray_tpu/qos/fair_queue.py):
+  strict priority between QoS classes, deficit-round-robin across tenants
+  within a class, FIFO within a tenant — replacing the unordered
+  ``Condition.notify`` scrum (which woke waiters in arbitrary OS order, so
+  a burst could starve an old waiter and priorities were impossible).
+  Deadlines (qos.RequestContext) are enforced while queued: an expired
+  waiter leaves with a typed DeadlineExceeded, counted, and never consumes
+  a replica slot.
 - Demand metrics (queued + ongoing) are pushed to the ServeController for
   autoscaling (reference: autoscaling_state.py handle metrics).
 """
@@ -15,7 +23,11 @@ from __future__ import annotations
 import random
 import threading
 import time
+from dataclasses import replace as _dc_replace
 from typing import Any, Optional
+
+from ray_tpu.qos import context as _qos
+from ray_tpu.qos.fair_queue import FairWaitQueue, Waiter
 
 SERVE_NAMESPACE = "serve"
 CONTROLLER_NAME = "__serve_controller__"
@@ -99,6 +111,16 @@ class _ReplicaSet:
             "serve.handle.affinity_evicted",
             "sticky model->replica pins dropped by the AFFINITY_CAP LRU bound",
             tag_keys=("app", "deployment")).set_default_tags(tags)
+        # QoS admission queue (strict class priority / DRR tenants / FIFO)
+        # + the queue-delay histogram the proxy's AIMD controller and the
+        # dashboards read. All fair-queue state is guarded by self.cond.
+        self._wfq = FairWaitQueue()
+        self._queue_delay = _metrics.Histogram(
+            "qos.queue.delay_s",
+            "seconds a request waited in the handle's fair admission queue",
+            boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2, 5],
+            tag_keys=("class", "app", "deployment"),
+        ).set_default_tags(tags)
 
     # -- membership --------------------------------------------------------
     def _maybe_refresh(self):
@@ -165,49 +187,139 @@ class _ReplicaSet:
                 }
                 # Keep counts for surviving replicas; drop departed ones.
                 self.ongoing = {n: self.ongoing.get(n, 0) for n in handles}
+                self._grant_locked()  # fresh capacity: hand out slots in policy order
                 self.cond.notify_all()
 
     # -- routing -----------------------------------------------------------
+    def _has_capacity_locked(self) -> bool:
+        return any(self.ongoing.get(n, 0) < self.max_ongoing for n in self.replicas)
+
+    def _grant_locked(self):
+        """Hand free replica slots to queued waiters in POLICY order (strict
+        class priority -> DRR across tenants -> FIFO within a tenant). Runs
+        under self.cond, called by whoever may have freed capacity: release,
+        membership refresh, the completion drainer, and a fresh enqueue.
+        Each granted waiter gets its slot reserved HERE (ongoing bumped
+        before its event is set), so a slow-to-wake waiter can never lose
+        its grant to a later one."""
+        now = time.time()
+        while not self._wfq.empty() and self._has_capacity_locked():
+            w = self._wfq.pop_next()
+            if w is None:
+                break
+            if w.deadline is not None and now >= w.deadline:
+                # Expired while queued: never takes a slot. The waiter's
+                # thread raises the (counted) DeadlineExceeded on wake.
+                w.expired = True
+                w.event.set()
+                continue
+            name = self._pick_locked(w.affinity)
+            if name is None:
+                # Unreachable today (_has_capacity_locked and _pick_locked
+                # read the same state under the same lock), but if the two
+                # ever drift the waiter must go back to the FRONT of its
+                # tenant FIFO — a tail re-push would break FIFO silently.
+                self._wfq.requeue_front(w)
+                break
+            self.ongoing[name] = self.ongoing.get(name, 0) + 1
+            w.admitted = (name, self.replicas[name])
+            w.event.set()
+
     def _admit(self, timeout_s: float, model_id: str = "", affinity_key: str = ""):
-        """Block until some replica has capacity; returns (name, handle) with
-        the ongoing count already incremented."""
-        deadline = time.time() + timeout_s
+        """Block until this request is granted a replica slot by the fair
+        queue; returns (name, handle) with the ongoing count already
+        incremented. QoS: the active RequestContext supplies the priority
+        class, tenant, and deadline; expiry raises a typed (and counted)
+        DeadlineExceeded, plain admission timeout keeps raising
+        TimeoutError."""
+        ctx = _qos.current()
+        now = time.time()
+        qdl = ctx.deadline if ctx is not None else None
+        if qdl is not None and now >= qdl:
+            _qos.raise_expired("handle", f"{self.app}/{self.deployment} (on arrival)")
+        deadline = now + timeout_s
+        deadline_eff = deadline if qdl is None else min(deadline, qdl)
+        w = Waiter(
+            rank=ctx.rank if ctx is not None else 0,
+            tenant=ctx.tenant if ctx is not None else _qos.DEFAULT_TENANT,
+            affinity=model_id or affinity_key,
+            deadline=deadline_eff,
+            enqueued_at=now,
+        )
+        klass = ctx.priority if ctx is not None else _qos.DEFAULT_PRIORITY
+        try:
+            # Fresh handle / stale membership: fetch routing info BEFORE
+            # parking — otherwise the first request per deployment per
+            # process would sit a full wait slice with nobody to grant.
+            self._maybe_refresh()
+        except Exception:
+            pass  # transient controller hiccup: retry until deadline
         with self.cond:
             self.queued += 1
+            self._wfq.push(w)
+            self._grant_locked()  # fast path: capacity free and we are next
         try:
             while True:
+                with self.cond:
+                    if w.admitted is not None:
+                        self._queue_delay.observe(
+                            time.time() - w.enqueued_at, tags={"class": klass})
+                        return w.admitted
+                    if w.expired:
+                        break  # counted below, outside the lock
+                    now = time.time()
+                    if now >= deadline_eff:
+                        self._wfq.discard(w)
+                        if qdl is not None and now >= qdl:
+                            break
+                        raise TimeoutError(
+                            f"no replica of {self.app}/{self.deployment} had capacity "
+                            f"within {timeout_s}s"
+                        )
+                    remaining = deadline_eff - now
+                # Re-poll membership at least every REFRESH_S while queued.
+                granted = w.event.wait(timeout=min(remaining, self.REFRESH_S))
+                if granted:
+                    continue
+                with self.cond:
+                    self.fetched_at = 0.0  # force refresh after a full wait
                 try:
                     self._maybe_refresh()
                 except Exception:
                     pass  # transient controller hiccup: retry until deadline
                 with self.cond:
-                    name = self._pick_locked(model_id or affinity_key)
-                    if name is not None:
-                        self.ongoing[name] = self.ongoing.get(name, 0) + 1
-                        return name, self.replicas[name]
-                    remaining = deadline - time.time()
-                    if remaining <= 0:
-                        raise TimeoutError(
-                            f"no replica of {self.app}/{self.deployment} had capacity "
-                            f"within {timeout_s}s"
-                        )
-                    # Re-poll membership at least every REFRESH_S while queued.
-                    self.cond.wait(timeout=min(remaining, self.REFRESH_S))
-                    self.fetched_at = 0.0  # force refresh after a wait
+                    self._grant_locked()
         finally:
             with self.cond:
                 self.queued -= 1
+        if qdl is None or time.time() < qdl:
+            # The waiter timed out at its ADMISSION deadline, not the
+            # request's own deadline: keep the legacy contract.
+            raise TimeoutError(
+                f"no replica of {self.app}/{self.deployment} had capacity "
+                f"within {timeout_s}s"
+            )
+        _qos.raise_expired("handle", f"{self.app}/{self.deployment} (while queued)")
 
     def _release(self, name: str):
         with self.cond:
             self.ongoing[name] = max(0, self.ongoing.get(name, 1) - 1)
+            self._grant_locked()
             self.cond.notify_all()
 
+    def _submission_ctx(self, rid: str):
+        """The wire context the replica call ships: the caller's active
+        RequestContext (or the default) with the handle-minted request id
+        attached, so the replica can be told about cancellation."""
+        base = _qos.current() or _qos.RequestContext()
+        return _qos.to_wire(_dc_replace(base, rid=rid))
+
     def route(self, method: str, args: tuple, kwargs: dict, timeout_s: float = 60.0,
-              model_id: str = "", affinity_key: str = ""):
+              model_id: str = "", affinity_key: str = "", rid: str = ""):
         """Pick a replica (pow-2 choices; sticky when a multiplexed model id
         or an affinity key is set), submit, return (ref, name)."""
         name, replica = self._admit(timeout_s, model_id=model_id, affinity_key=affinity_key)
+        token = _qos.activate(self._submission_ctx(rid))
         try:
             if model_id:
                 ref = replica.handle_request.remote(method, args, kwargs, model_id)
@@ -218,6 +330,8 @@ class _ReplicaSet:
             with self.cond:
                 self.fetched_at = 0.0
             raise
+        finally:
+            _qos.deactivate(token)
         with self.cond:
             self._outstanding.append((ref, name))
             self._ensure_threads()
@@ -226,7 +340,7 @@ class _ReplicaSet:
 
     def route_streaming(self, method: str, args: tuple, kwargs: dict,
                         timeout_s: float = 60.0, proxy: bool = False,
-                        model_id: str = "", affinity_key: str = ""):
+                        model_id: str = "", affinity_key: str = "", rid: str = ""):
         """Streaming variant: returns (ObjectRefGenerator, name). The ongoing
         count is held until the caller exhausts/closes the stream and calls
         _release(name) (DeploymentResponseGenerator owns that)."""
@@ -234,6 +348,7 @@ class _ReplicaSet:
         actor_method = (
             replica.handle_request_proxy if proxy else replica.handle_request_streaming
         )
+        token = _qos.activate(self._submission_ctx(rid))
         try:
             if model_id:
                 gen = actor_method.options(num_returns="streaming").remote(
@@ -246,9 +361,33 @@ class _ReplicaSet:
             with self.cond:
                 self.fetched_at = 0.0
             raise
+        finally:
+            _qos.deactivate(token)
         with self.cond:
             self._ensure_threads()  # demand pusher must see streaming load too
         return gen, name
+
+    def _cancel_downstream(self, name: str, rid: str):
+        """Best-effort: tell the replica serving ``rid`` that its caller
+        gave up (sets the request's cancel event — cooperative user code
+        checks qos.cancel_requested() and frees the slot early)."""
+        if not rid:
+            return
+        with self.cond:
+            replica = self.replicas.get(name)
+        if replica is None:
+            return
+        # Control-plane send: MUST NOT inherit the data request's (possibly
+        # already-expired) context — the worker gate would drop the cancel
+        # itself with a second counted expiry and the replica would never
+        # see it.
+        token = _qos.suspend()
+        try:
+            replica.cancel_request.remote(rid)
+        except Exception:
+            pass  # replica gone: nothing left to cancel
+        finally:
+            _qos.deactivate(token)
 
     def _pick_locked(self, affinity: str = "") -> Optional[str]:
         live = [n for n in self.replicas if self.ongoing.get(n, 0) < self.max_ongoing]
@@ -334,6 +473,7 @@ class _ReplicaSet:
                     else:
                         kept.append((ref, name))
                 self._outstanding = kept
+                self._grant_locked()  # freed slots flow to queued waiters in order
                 self.cond.notify_all()
 
     def _push_loop(self):
@@ -367,7 +507,13 @@ class _ReplicaSet:
 
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference: handle.py
-    DeploymentResponse). `result()` retries once on replica death."""
+    DeploymentResponse). `result()` retries once on replica death.
+
+    Cancel-on-client-timeout: when `result(timeout)` gives up, the response
+    cancels its in-flight downstream work instead of orphaning it — the
+    handle's admission slot is released immediately and the replica's cancel
+    event fires so cooperative user code (qos.cancel_requested(), the LLM
+    generate loop) stops burning capacity for a departed caller."""
 
     def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict,
                  model_id: str = "", affinity_key: str = ""):
@@ -377,24 +523,54 @@ class DeploymentResponse:
         self._kwargs = kwargs
         self._model_id = model_id
         self._affinity_key = affinity_key
+        self._rid = _qos.mint_rid()
+        self._cancelled = False
         self._ref, self._idx = rs.route(method, args, kwargs, model_id=model_id,
-                                        affinity_key=affinity_key)
+                                        affinity_key=affinity_key, rid=self._rid)
 
     def result(self, timeout: float | None = 60.0):
         import ray_tpu as rt
         from ray_tpu.core.worker import ActorDiedError
+        from ray_tpu.qos import DeadlineExceeded
 
         for attempt in range(3):
             try:
                 return rt.get(self._ref, timeout=timeout)
+            except DeadlineExceeded:
+                # The request died of ITS OWN deadline at some hop: there is
+                # no downstream work left to cancel — surface it typed.
+                raise
+            except TimeoutError:
+                # The CALLER gave up (result-timeout): free the admission
+                # slot now and cancel the downstream work.
+                self.cancel()
+                raise
             except ActorDiedError:
                 self._rs.fail_over(self._idx)
                 if attempt == 2:
                     raise
                 self._ref, self._idx = self._rs.route(
                     self._method, self._args, self._kwargs, model_id=self._model_id,
-                    affinity_key=self._affinity_key,
+                    affinity_key=self._affinity_key, rid=self._rid,
                 )
+
+    def cancel(self):
+        """Abandon this request: release the handle's admission slot (the
+        completion drainer will not double-release — the outstanding entry
+        is withdrawn here) and fire the replica-side cancel event."""
+        if self._cancelled:
+            return
+        self._cancelled = True
+        rs = self._rs
+        with rs.cond:
+            before = len(rs._outstanding)
+            rs._outstanding = [
+                (r, n) for r, n in rs._outstanding if r is not self._ref
+            ]
+            withdrawn = len(rs._outstanding) != before
+        if withdrawn:
+            rs._release(self._idx)
+        rs._cancel_downstream(self._idx, self._rid)
 
     def _to_object_ref(self):
         return self._ref
@@ -410,9 +586,10 @@ class DeploymentResponseGenerator:
                  proxy: bool = False, model_id: str = "", affinity_key: str = ""):
         self._rs = rs
         self._released = False
+        self._rid = _qos.mint_rid()
         self._gen, self._name = rs.route_streaming(
             method, args, kwargs, proxy=proxy, model_id=model_id,
-            affinity_key=affinity_key,
+            affinity_key=affinity_key, rid=self._rid,
         )
 
     def __iter__(self):
@@ -483,11 +660,22 @@ class DeploymentResponseGenerator:
             self._release()
         return kind, payload
 
-    def close(self):
+    def close(self, abandon: bool = True):
         """Stop consuming: cancels the replica-side generator task (its next
-        yield observes the close and the user generator is closed), then
-        frees this stream's admission slot."""
+        yield observes the close and the user generator is closed), fires
+        the request's cancel event (a producer blocked BETWEEN yields — an
+        engine wait loop — sees qos.cancel_requested() without waiting for
+        its next yield), then frees this stream's admission slot.
+
+        ``abandon=False``: the logical response already completed (the
+        proxy's buffered 'value' reply) — skip the downstream cancel RPC;
+        one control-plane actor call per plain HTTP request would be pure
+        hot-path waste and would churn the replica's early-cancel memory.
+        A stream whose final reply already landed (completed()) has nothing
+        left to cancel either way."""
         self._gen.close()
+        if abandon and not self._released and not self._gen.completed():
+            self._rs._cancel_downstream(self._name, self._rid)
         self._release()
 
     def __del__(self):

@@ -20,6 +20,18 @@ import traceback
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlsplit
 
+from ray_tpu.qos import context as _qos
+
+
+def _capped_timeout(timeout_s, default: float = 60.0) -> float:
+    """THE client-timeout policy for both binary-RPC dispatch lanes:
+    client-controlled, but CAPPED (qos.parse_timeout_s — shared with the
+    HTTP header and OpenAI-body mappings) — the dispatch pool is shared
+    with routing/health, so an unbounded wait would let one caller pin its
+    threads indefinitely. 0/None means "no opinion" -> default."""
+    t = _qos.parse_timeout_s(timeout_s)
+    return t if t > 0 else min(default, _qos.MAX_CLIENT_TIMEOUT_S)
+
 
 class HTTPResponse:
     """Return one of these from a deployment's __call__ to control the HTTP
@@ -87,6 +99,27 @@ class _StreamBody:
         self.deployment = deployment
 
 
+def _qos_wire_from_headers(headers: dict) -> Optional[tuple]:
+    """Map the QoS ingress headers (``x-priority`` / ``x-tenant`` /
+    ``x-request-timeout-s``) to a wire context tuple, or None when absent
+    (the quiet path installs nothing). The client's timeout becomes an
+    ABSOLUTE deadline here, once, on the shared clock — every later hop
+    compares against it instead of re-deriving."""
+    prio = headers.get("x-priority", "").strip().lower()
+    tenant = headers.get("x-tenant", "").strip()
+    tmo = headers.get("x-request-timeout-s", "").strip()
+    if not (prio or tenant or tmo):
+        return None
+    rank = _qos.PRIORITIES.index(prio) if prio in _qos.PRIORITIES else 0
+    deadline = None
+    t = _qos.parse_timeout_s(tmo)
+    if t > 0:
+        from ray_tpu.util import tracing as _tracing
+
+        deadline = _tracing.now() + t
+    return (rank, tenant or _qos.DEFAULT_TENANT, deadline, "")
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -136,6 +169,42 @@ class ProxyActor:
             "streamed items per second over the last completed stream",
             tag_keys=("app", "deployment"),
         )
+        # -- QoS plane: adaptive admission (AIMD on observed queue delay,
+        # class-tiered shedding) + its observability. None = plane off
+        # (Config.qos_enabled=False), the overload bench's OFF arm.
+        self._shed_total = _metrics.Counter(
+            "serve.request.shed_total",
+            "requests rejected by the proxy's adaptive admission (429 + Retry-After)",
+            tag_keys=("reason", "class"),
+        )
+        self._limit_gauge = _metrics.Gauge(
+            "qos.admission.limit", "the proxy's adaptive concurrency limit")
+        self._inflight_gauge = _metrics.Gauge(
+            "qos.admission.inflight", "requests currently admitted by the proxy")
+        from ray_tpu.core import api as _api
+        from ray_tpu.core.config import get_config
+        from ray_tpu.qos import AdmissionController
+
+        # The CLUSTER config: a spawned worker adopts the head's config onto
+        # its CoreWorker at registration (adopt_cluster) — the process-global
+        # get_config() would silently read this process's env defaults.
+        core = getattr(_api, "_global_worker", None)
+        cfg = getattr(core, "config", None) or get_config()
+        self._qos_ctl: Optional[AdmissionController] = None
+        if cfg.qos_enabled:
+            def _on_adapt(limit, inflight):
+                self._limit_gauge.set(limit)
+                self._inflight_gauge.set(inflight)
+
+            self._qos_ctl = AdmissionController(
+                target_delay_s=cfg.qos_target_delay_s,
+                min_limit=cfg.qos_min_concurrency,
+                max_limit=cfg.qos_max_concurrency,
+                initial_limit=cfg.qos_initial_concurrency,
+                interval_s=cfg.qos_adapt_interval_s,
+                on_adapt=_on_adapt,
+            )
+            self._limit_gauge.set(self._qos_ctl.limit)
         self._loop = asyncio.new_event_loop()
         self._ready = threading.Event()
         self._thread = threading.Thread(target=self._serve, name="serve-proxy", daemon=True)
@@ -214,11 +283,7 @@ class ProxyActor:
                             )
                             if req.affinity_key:
                                 handle = handle.options(affinity_key=req.affinity_key)
-                            # Client-controlled timeout, CAPPED: the dispatch
-                            # pool is shared with HTTP/health routing — an
-                            # unbounded .result() would let one caller pin
-                            # its threads indefinitely.
-                            timeout = min(req.timeout_s or 60.0, 600.0)
+                            timeout = _capped_timeout(req.timeout_s)
                             result = handle.remote(
                                 *payload.get("args", []), **payload.get("kwargs", {})
                             ).result(timeout=timeout)
@@ -229,9 +294,16 @@ class ProxyActor:
                             reply.error = f"{type(e).__name__}: {e}"
                         return PROTO_MAGIC + reply.SerializeToString()
                     try:
-                        app, deployment, method, args, kwargs = pickle.loads(frame)
+                        # 5-tuple (legacy) or 6-tuple with a trailing
+                        # client timeout — both lanes share the ONE
+                        # capped-timeout policy (_capped_timeout); the
+                        # legacy shape used to hardcode result(timeout=60)
+                        # while the protobuf lane honored req.timeout_s.
+                        fields = pickle.loads(frame)
+                        app, deployment, method, args, kwargs = fields[:5]
+                        timeout = _capped_timeout(fields[5] if len(fields) > 5 else 0.0)
                         handle = DeploymentHandle(deployment, app, method or "__call__")
-                        result = handle.remote(*args, **kwargs).result(timeout=60)
+                        result = handle.remote(*args, **kwargs).result(timeout=timeout)
                         return pickle.dumps(("ok", result), protocol=5)
                     except Exception as e:  # noqa: BLE001 — serialized to the client
                         return pickle.dumps(("err", f"{type(e).__name__}: {e}"), protocol=5)
@@ -274,13 +346,20 @@ class ProxyActor:
                 resp = await self._loop.run_in_executor(
                     self._pool, self._dispatch, method, target, headers, body
                 )
-                if len(resp) == 4:  # streaming: (status, chunk_iter, ctype, True)
+                if len(resp) == 4 and resp[3] is True:
+                    # streaming: (status, chunk_iter, ctype, True)
                     await self._write_streaming(writer, resp)
                 else:
-                    status, payload, ctype = resp
+                    # buffered: (status, payload, ctype[, extra_headers])
+                    status, payload, ctype = resp[:3]
+                    extra = resp[3] if len(resp) == 4 else None
+                    extra_lines = "".join(
+                        f"{k}: {v}\r\n" for k, v in (extra or {}).items()
+                    )
                     head = (
                         f"HTTP/1.1 {status}\r\ncontent-type: {ctype}\r\n"
-                        f"content-length: {len(payload)}\r\nconnection: keep-alive\r\n\r\n"
+                        f"content-length: {len(payload)}\r\n{extra_lines}"
+                        f"connection: keep-alive\r\n\r\n"
                     )
                     writer.write(head.encode() + payload)
                     await writer.drain()
@@ -452,6 +531,20 @@ class ProxyActor:
                           path=urlsplit(target).path or "/"):
             return self._dispatch_inner(method, target, headers, body)
 
+    def _shed_response(self, klass: str, retry_after: float):
+        """Reject one request under overload: 429 + Retry-After, counted
+        (serve.request.shed_total{reason,class}) and dropped onto the active
+        trace — never a silent rejection (graftlint: counted-sheds)."""
+        self._shed_total.inc(tags={"reason": "overload", "class": klass})
+        from ray_tpu.util import tracing as _tracing
+
+        _tracing.event("qos.shed", reason="overload", cls=klass)
+        body = json.dumps({
+            "error": "overloaded", "class": klass, "retry_after_s": retry_after,
+        }).encode()
+        return ("429 Too Many Requests", body, "application/json",
+                {"retry-after": f"{retry_after:g}"})
+
     # -- routing (runs on thread pool) -------------------------------------
     def _route_table(self) -> dict:
         now = time.time()
@@ -487,46 +580,85 @@ class ProxyActor:
         sub_path = path[len(prefix.rstrip("/")) :] or "/"
         query = {k: v[0] if len(v) == 1 else v for k, v in parse_qs(parts.query).items()}
         req = Request(method, sub_path, query, headers, body)
+        # -- QoS ingress: headers -> RequestContext for this dispatch (the
+        # context then rides the handle -> replica call like the trace ctx),
+        # adaptive admission (shed with 429 before any routing work), and
+        # the "proxy" deadline hop. With the plane off (qos_enabled=False)
+        # headers are NOT mapped either — the OFF baseline is the pre-plane
+        # proxy: no classes, no deadlines, no shedding.
+        qwire = _qos_wire_from_headers(headers) if self._qos_ctl is not None else None
+        qtoken = _qos.activate(qwire)
+        rank = qwire[0] if qwire is not None else 0
+        klass = _qos.PRIORITIES[rank]
+        admitted = False
         try:
-            from ray_tpu.core.worker import ActorDiedError
-            from ray_tpu.serve.handle import DeploymentResponseGenerator, _replica_set
+            if self._qos_ctl is not None:
+                ok, retry_after = self._qos_ctl.try_admit(rank)
+                if not ok:
+                    return self._shed_response(klass, retry_after)
+                admitted = True
+            try:
+                from ray_tpu.core.worker import ActorDiedError
+                from ray_tpu.serve.handle import DeploymentResponseGenerator, _replica_set
 
-            rs = _replica_set(app, deployment)
-            # Replica affinity: a deployment-provided router policy maps the
-            # request to a sticky key (reference: PrefixCacheAffinityRouter —
-            # requests sharing a prompt prefix land on the replica whose
-            # engine caches those KV pages); clients can also pass an
-            # x-affinity-key header directly.
-            akey = headers.get("x-affinity-key", "")
-            router_fn = getattr(rs, "request_router", None)
-            if router_fn is None:
-                rs._maybe_refresh()  # router policy arrives with routing info
+                _qos.check_deadline("proxy", detail=path)
+                rs = _replica_set(app, deployment)
+                # Replica affinity: a deployment-provided router policy maps the
+                # request to a sticky key (reference: PrefixCacheAffinityRouter —
+                # requests sharing a prompt prefix land on the replica whose
+                # engine caches those KV pages); clients can also pass an
+                # x-affinity-key header directly.
+                akey = headers.get("x-affinity-key", "")
                 router_fn = getattr(rs, "request_router", None)
-            if router_fn is not None:
-                try:
-                    akey = str(router_fn(req) or akey)
-                except Exception:
-                    traceback.print_exc()
-            # Retry replica death only before the first item: nothing has
-            # reached the client yet, so re-routing is safe (mid-stream death
-            # is surfaced — items were already delivered).
-            for attempt in range(3):
-                gen = DeploymentResponseGenerator(rs, "__call__", (req,), {},
-                                                  proxy=True, affinity_key=akey)
-                try:
-                    tag, first = next(gen)
-                    break
-                except StopIteration:
-                    return "200 OK", b"", "text/plain"
-                except ActorDiedError:
-                    rs.fail_over("")
-                    if attempt == 2:
-                        raise
-        except Exception as e:
-            traceback.print_exc()
-            return "500 Internal Server Error", json.dumps({"error": str(e)}).encode(), "application/json"
+                if router_fn is None:
+                    rs._maybe_refresh()  # router policy arrives with routing info
+                    router_fn = getattr(rs, "request_router", None)
+                if router_fn is not None:
+                    try:
+                        akey = str(router_fn(req) or akey)
+                    except Exception:
+                        traceback.print_exc()
+                # Retry replica death only before the first item: nothing has
+                # reached the client yet, so re-routing is safe (mid-stream death
+                # is surfaced — items were already delivered).
+                for attempt in range(3):
+                    t_admit = time.perf_counter()
+                    gen = DeploymentResponseGenerator(rs, "__call__", (req,), {},
+                                                      proxy=True, affinity_key=akey)
+                    if self._qos_ctl is not None:
+                        # The AIMD signal: time spent waiting for a replica
+                        # slot in the handle's fair queue (pure queueing —
+                        # service time is NOT part of it), per class: with
+                        # strict priority, interactive's near-zero delays
+                        # must not mask a background standing queue.
+                        self._qos_ctl.record_delay(
+                            time.perf_counter() - t_admit, rank)
+                    try:
+                        tag, first = next(gen)
+                        break
+                    except StopIteration:
+                        return "200 OK", b"", "text/plain"
+                    except ActorDiedError:
+                        rs.fail_over("")
+                        if attempt == 2:
+                            raise
+            except _qos.DeadlineExceeded as e:
+                # Counted at the hop that dropped it (expired_total{hop});
+                # the client sees a typed timeout status, not a 500.
+                return ("504 Gateway Timeout",
+                        json.dumps({"error": str(e)}).encode(), "application/json")
+            except Exception as e:
+                traceback.print_exc()
+                return "500 Internal Server Error", json.dumps({"error": str(e)}).encode(), "application/json"
+        finally:
+            # Admission covers the queue+dispatch phase (for streaming
+            # responses the body drains on the proxy loop afterwards); the
+            # queue-delay signal is what the AIMD limit controls.
+            if admitted:
+                self._qos_ctl.release(rank)
+            _qos.deactivate(qtoken)
         if tag == "value":
-            gen.close()
+            gen.close(abandon=False)  # response complete: nothing to cancel
             result = first
             if isinstance(result, HTTPResponse):
                 return result.status_line, result.body, result.content_type

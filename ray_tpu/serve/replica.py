@@ -14,6 +14,8 @@ import time
 import traceback
 from typing import Any
 
+from ray_tpu import chaos as _chaos
+from ray_tpu.qos import context as _qos
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
 
@@ -38,6 +40,12 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._started_at = time.time()
+        # QoS cancellation: rid -> Event for requests executing HERE, plus a
+        # bounded memory of cancels that arrived before their request did
+        # (cancel_request and the request ride separate frames).
+        self._cancel_events: dict[str, threading.Event] = {}
+        self._cancelled_early: dict[str, float] = {}
+        self._cancel_early_dropped = 0  # counted trim: bounded memory
         # Per-deployment runtime metrics (reporter -> controller -> /metrics):
         # request latency histogram + request counter, tagged by app/deployment
         # so multi-app clusters stay separable on the Prometheus side.
@@ -73,6 +81,63 @@ class Replica:
             return self._instance
         return getattr(self._instance, method or "__call__")
 
+    def _enter_request(self, method: str):
+        """Shared per-request prologue for all three call paths.
+
+        1. QoS "replica" inbox gate: a request whose deadline already passed
+           is dropped HERE, typed and counted — it never reaches user code
+           (the core invariant the overload_storm scenario pins).
+        2. serve.replica.slow chaos gate: injected per-request exec delay
+           (AFTER the gate — it models slow execution, not a bypassed gate).
+        3. Cancel-event registration for the request's rid, so cooperative
+           user code sees qos.cancel_requested() when the caller gives up.
+
+        Returns (rid, cancel_token, gate_now) for _leave_request."""
+        gate_now = _qos.check_deadline(
+            "replica", detail=f"{self.deployment_name}.{method or '__call__'}")
+        # Tripwire BEFORE the chaos delay: the delay models slow EXECUTION
+        # (the request legitimately began unexpired); a long-stale deadline
+        # here means an upstream gate was bypassed.
+        _qos.mark_exec_start("replica")
+        fault = _chaos.maybe_inject("serve.replica.slow",
+                                    deployment=self.deployment_name,
+                                    method=method or "__call__")
+        if fault is not None and fault.kind == "delay":
+            time.sleep(fault.delay_s)
+        ctx = _qos.current()
+        rid = ctx.rid if ctx is not None else ""
+        token = None
+        if rid:
+            ev = threading.Event()
+            with self._lock:
+                if self._cancelled_early.pop(rid, None) is not None:
+                    ev.set()  # the cancel frame outran the request frame
+                self._cancel_events[rid] = ev
+            token = _qos.set_cancel_event(ev)
+        return rid, token, gate_now
+
+    def _leave_request(self, rid: str, token):
+        if rid:
+            with self._lock:
+                self._cancel_events.pop(rid, None)
+            _qos.reset_cancel_event(token)
+
+    def cancel_request(self, rid: str) -> bool:
+        """The caller abandoned request ``rid`` (client timeout/disconnect):
+        fire its cancel event so the executing user code can bail and free
+        this replica's capacity. Cancels that arrive before their request
+        are remembered (bounded, counted trim)."""
+        with self._lock:
+            ev = self._cancel_events.get(rid)
+            if ev is not None:
+                ev.set()
+                return True
+            self._cancelled_early[rid] = time.time()
+            while len(self._cancelled_early) > 4096:
+                self._cancelled_early.pop(next(iter(self._cancelled_early)))
+                self._cancel_early_dropped += 1
+        return False
+
     def handle_request(self, method: str, args: tuple, kwargs: dict, model_id: str = ""):
         from ray_tpu.serve.multiplex import _set_model_id
 
@@ -80,14 +145,17 @@ class Replica:
             self._ongoing += 1
             self._total += 1
         token = _set_model_id(model_id) if model_id else None
+        rid = qtoken = None
         t0 = time.perf_counter()
         try:
             # child_span: a no-op unless the caller's trace context arrived
             # with the actor call (proxy/handle root span).
             with _tracing.child_span(f"serve.replica.{self.deployment_name}",
                                      method=method or "__call__"):
+                rid, qtoken, _ = self._enter_request(method)
                 return self._resolve_fn(method)(*args, **kwargs)
         finally:
+            self._leave_request(rid or "", qtoken)
             self._latency.observe(time.perf_counter() - t0)
             self._requests.inc()
             if token is not None:
@@ -111,10 +179,12 @@ class Replica:
             self._ongoing += 1
             self._total += 1
         token = _set_model_id(model_id) if model_id else None
+        rid = qtoken = None
         t0 = time.perf_counter()
         try:
             with _tracing.child_span(f"serve.replica.{self.deployment_name}",
                                      method=method or "__call__", stream=True):
+                rid, qtoken, _ = self._enter_request(method)
                 out = self._resolve_fn(method)(*args, **kwargs)
                 if not inspect.isgenerator(out) and not hasattr(out, "__next__"):
                     raise TypeError(
@@ -123,6 +193,7 @@ class Replica:
                     )
                 yield from out
         finally:
+            self._leave_request(rid or "", qtoken)
             self._latency.observe(time.perf_counter() - t0)
             self._requests.inc()
             if token is not None:
@@ -144,10 +215,12 @@ class Replica:
             self._ongoing += 1
             self._total += 1
         token = _set_model_id(model_id) if model_id else None
+        rid = qtoken = None
         t0 = time.perf_counter()
         try:
             with _tracing.child_span(f"serve.replica.{self.deployment_name}",
                                      method=method or "__call__", proxy=True):
+                rid, qtoken, _ = self._enter_request(method)
                 out = self._resolve_fn(method)(*args, **kwargs)
                 if inspect.isgenerator(out) or (
                     hasattr(out, "__next__") and not isinstance(out, (str, bytes))
@@ -157,6 +230,7 @@ class Replica:
                 else:
                     yield ("value", out)
         finally:
+            self._leave_request(rid or "", qtoken)
             self._latency.observe(time.perf_counter() - t0)
             self._requests.inc()
             if token is not None:

@@ -166,6 +166,18 @@ def test_worker_kill_scenario_smoke():
     assert report["details"]["retried_attempts"] >= 1
 
 
+def test_overload_storm_scenario_smoke():
+    """The QoS acceptance scenario: ~3x overload with chaos-injected replica
+    slowness — interactive goodput holds (p99 bounded), every shed/expiry is
+    visible on /metrics with exact accounting, and no deadline-expired
+    request ever reaches user code."""
+    report = run_scenario("overload_storm", seed=5, quick=True)
+    assert report["ok"], report
+    assert report["details"]["shed"] >= 1
+    assert report["details"]["invoked"] > 0
+    assert report["invariants"]["faults_visible_in_metrics"]["ok"]
+
+
 def test_same_seed_replays_identical_injection_sequence():
     """The replay contract, asserted on two REAL runs: identical seed +
     schedule + workload => byte-identical normalized injection logs."""

@@ -834,6 +834,78 @@ def test_syntax_error_is_reported_not_crashed():
 
 
 # ---------------------------------------------------------------------------
+# counted-sheds
+# ---------------------------------------------------------------------------
+
+def test_counted_sheds_fires_on_uncounted_deadline_raise():
+    r = _lint("""
+        def gate(ctx, now):
+            if now >= ctx.deadline:
+                raise DeadlineExceeded("expired at gate")
+    """)
+    hits = [f for f in r.findings if f.rule == "counted-sheds"]
+    assert len(hits) == 1 and hits[0].line == 4
+
+
+def test_counted_sheds_fires_on_uncounted_shed_function():
+    r = _lint("""
+        class Proxy:
+            def _shed_response(self, klass):
+                return ("429 Too Many Requests", b"{}", "application/json")
+    """)
+    hits = [f for f in r.findings if f.rule == "counted-sheds"]
+    assert len(hits) == 1 and "shed path" in hits[0].message
+
+
+def test_counted_sheds_quiet_when_counted():
+    r = _lint("""
+        class Proxy:
+            def _shed_response(self, klass):
+                self._shed_total.inc(tags={"class": klass})
+                return ("429 Too Many Requests", b"{}", "application/json")
+
+        def gate(ctx, now, stats):
+            if now >= ctx.deadline:
+                stats.expired_count += 1
+                raise DeadlineExceeded("expired at gate")
+    """)
+    assert "counted-sheds" not in _rules_hit(r)
+
+
+def test_counted_sheds_ignores_shed_substrings_and_other_raises():
+    """"finished"/"watershed" contain "shed" as a substring but are not shed
+    paths; raising other exception types is not a request drop."""
+    r = _lint("""
+        def on_finished(self):
+            raise TimeoutError("not a qos drop")
+
+        def watershed_model(x):
+            return x
+    """)
+    assert "counted-sheds" not in _rules_hit(r)
+
+
+def test_counted_sheds_suppressed_with_reason():
+    r = _lint("""
+        def gate(ctx, now):
+            if now >= ctx.deadline:
+                raise DeadlineExceeded("x")  # graftlint: disable=counted-sheds  caller tallies this drop
+    """)
+    assert "counted-sheds" not in _rules_hit(r)
+    assert len(r.suppressions) == 1
+
+
+def test_counted_sheds_suppressed_without_reason_still_fires():
+    r = _lint("""
+        def gate(ctx, now):
+            if now >= ctx.deadline:
+                raise DeadlineExceeded("x")  # graftlint: disable=counted-sheds
+    """)
+    assert "counted-sheds" in _rules_hit(r)
+    assert BAD_SUPPRESSION in _rules_hit(r)
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: whole tree at zero, report written, CLI contract
 # ---------------------------------------------------------------------------
 
