@@ -543,6 +543,145 @@ def _scn_overload_storm(seed: int, quick: bool) -> dict:
     }
 
 
+def _scn_ckpt_kill_mid_save(seed: int, quick: bool) -> dict:
+    """Checkpoint plane under fire: a worker dies mid sharded save, a chunk
+    write fails in a later attempt, and the publish swap is delayed. The
+    core invariant battery, beyond the standard one: a committed manifest
+    is always fully restorable (byte-identical, same-mesh AND resharded); an
+    uncommitted one is never visible (manifest listing, state API, channel
+    pointer); chunk refcounts balance after top-K eviction (no orphaned and
+    no missing chunks)."""
+    import numpy as np
+    import ray_tpu as rt  # noqa: F401 — session-scoped driver for the battery
+    from ray_tpu.core.api import Cluster, init
+
+    cfg = _fresh_config()
+    cfg.chaos_spec = json.dumps({
+        "seed": seed,
+        "rules": [
+            # nth counts (rank, array) gate hits: 4/step with 2 ranks x 2
+            # arrays -> rank 0 dies at the start of step 1's save.
+            {"site": "ckpt.worker.kill_mid_save", "kind": "kill", "nth": 5},
+            # nth counts NEW chunk writes (dedup hits never reach the gate):
+            # lands on a hot chunk a few steps later, aborting that attempt.
+            {"site": "ckpt.chunk.write", "kind": "error", "nth": 6},
+            {"site": "ckpt.publish.swap", "kind": "delay", "nth": 1, "delay_s": 0.05},
+        ],
+    })
+    _plan.install_from_json(cfg.chaos_spec)
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=2)
+    init(address=cluster.address, config=cfg)
+    from ray_tpu import ckpt as _ckpt
+    from ray_tpu import state as _state
+
+    storage = tempfile.mkdtemp(prefix="raytpu_ckpt_chaos_")
+    store = _ckpt.ChunkStore(storage, chunk_size=8192)
+    manifests = _ckpt.ManifestStore(storage, num_to_keep=2, chunk_store=store)
+    workers, rows = 2, 64
+    steps = 5 if quick else 8
+    rng = np.random.default_rng(seed + 1)
+    frozen = rng.standard_normal((rows, 64)).astype(np.float32)  # dedup fodder
+    committed: dict = {}  # ckpt_id -> reference arrays for byte-compare
+    aborted = 0
+    last_committed = None
+    for step in range(steps):
+        hot = np.full((rows, 48), float(step + 1), np.float32)
+        ckpt_id = _ckpt.new_ckpt_id(step)
+        half = rows // workers
+        parts = []
+        partial: set = set()  # new digests as they land (dead-rank cleanup)
+        for rank in range(workers):
+            lo, hi = rank * half, (rank + 1) * half
+            snap = {
+                "model/frozen": {"dtype": "float32", "shape": [rows, 64],
+                                 "shards": [([[lo, hi], [0, 64]], frozen[lo:hi])]},
+                "model/hot": {"dtype": "float32", "shape": [rows, 48],
+                              "shards": [([[lo, hi], [0, 48]], hot[lo:hi])]},
+            }
+            try:
+                parts.append(_ckpt.write_part(store, snap, rank=rank, step=step,
+                                              new_out=partial))
+            except Exception:
+                pass  # this rank died mid-save: it never acks
+        try:
+            m = _ckpt.commit_parts(manifests, ckpt_id, step, parts, workers,
+                                   channel="chaos", meta={"step": step})
+            _ckpt.publish_checkpoint(m, "chaos")
+            committed[m["ckpt_id"]] = {"model/frozen": frozen.copy(),
+                                       "model/hot": hot.copy()}
+            last_committed = m["ckpt_id"]
+        except _ckpt.CommitAborted:
+            aborted += 1
+            # Reclaim the dead rank's partial writes too (commit_parts only
+            # sees acked parts' chunk sets).
+            manifests.abort(ckpt_id, partial)
+            _ckpt.register_manifest({"ckpt_id": ckpt_id, "step": step,
+                                     "channel": "chaos", "status": "aborted"})
+    _require(aborted >= 2, f"faults never aborted an attempt (aborted={aborted})")
+    _require(last_committed is not None, "no attempt ever committed")
+
+    # -- invariant: an uncommitted manifest is never visible ---------------
+    listed = manifests.list_ids()
+    _require(set(listed) <= set(committed),
+             f"uncommitted manifest visible in the store listing: {listed}")
+    api_rows = _state.list_checkpoints(channel="chaos", limit=100)
+    api_committed = {c["ckpt_id"] for c in api_rows["checkpoints"]
+                     if c["status"] == "committed"}
+    _require(api_committed <= set(committed),
+             f"state API lists an uncommitted manifest as committed: {api_committed}")
+    _require(api_rows["channels"].get("chaos") == last_committed,
+             "publication channel does not point at the last committed manifest")
+
+    # -- invariant: every committed manifest restores byte-identically -----
+    for ckpt_id in listed:
+        m = manifests.load(ckpt_id)
+        full = _ckpt.restore(m, store)
+        for path, want in committed[ckpt_id].items():
+            _require(full[path].tobytes() == want.tobytes(),
+                     f"{ckpt_id}:{path} same-mesh restore not byte-identical")
+        # Resharded (2 source hosts -> 3 uneven target hosts): reassembled
+        # target shards must equal the same-mesh restore bytes.
+        for path, want in committed[ckpt_id].items():
+            cuts = [0, 10, 37, rows]
+            got = np.concatenate([
+                _ckpt.restore(m, store, target_indices={
+                    path: [[cuts[i], cuts[i + 1]], [0, want.shape[1]]]})[path]
+                for i in range(3)
+            ])
+            _require(got.tobytes() == want.tobytes(),
+                     f"{ckpt_id}:{path} resharded restore diverged from same-mesh")
+
+    # -- invariant: refcounts balance after top-K eviction -----------------
+    _require(len(listed) <= 2, f"top-K retention kept {len(listed)} manifests")
+    ver = manifests.verify()
+    _require(ver["ok"], f"chunk refcounts out of balance after eviction: {ver}")
+
+    # -- publication: delayed swap still lands, weights verified -----------
+    swapped: dict = {}
+    sub = _ckpt.WeightSubscriber(
+        "chaos", lambda tree, s: swapped.update(version=s["ckpt_id"], tree=tree),
+        poll_interval_s=0.2, auto_start=False)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and swapped.get("version") != last_committed:
+        sub.check_once()
+        time.sleep(0.1)
+    _require(swapped.get("version") == last_committed,
+             f"subscriber never swapped to {last_committed}: {sub.last_error}")
+    want = committed[last_committed]["model/hot"]
+    _require(swapped["tree"]["model"]["hot"].tobytes() == want.tobytes(),
+             "swapped weights differ from the committed checkpoint")
+    sub.stop()
+    return {
+        "cluster": cluster,
+        "details": {"steps": steps, "committed": len(committed),
+                    "aborted": aborted, "retained": listed,
+                    "chunks_on_disk": ver["chunks"]},
+        "min_injections": 3,  # kill + chunk-write error + swap delay
+        "min_metric_injections": 3,
+    }
+
+
 SCENARIOS: dict = {
     "worker_kill": _scn_worker_kill,
     "pull_source_death": _scn_pull_source_death,
@@ -550,6 +689,7 @@ SCENARIOS: dict = {
     "mac_corrupt_storm": _scn_mac_corrupt_storm,
     "tpu_preempt_drain": _scn_tpu_preempt_drain,
     "overload_storm": _scn_overload_storm,
+    "ckpt_kill_mid_save": _scn_ckpt_kill_mid_save,
 }
 
 

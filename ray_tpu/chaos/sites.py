@@ -111,6 +111,31 @@ SITES: dict = {
                      "expiry at every hop, interactive goodput under overload "
                      "(scenario overload_storm)",
     },
+    # -- L5: checkpoint & weight-publication plane ------------------------
+    "ckpt.chunk.write": {
+        "layer": "ckpt",
+        "kinds": {"error"},
+        "desc": "one content-addressed chunk about to be written to the chunk tier",
+        "exercises": "save-attempt abort: the manifest never commits, new "
+                     "chunks of the attempt are reclaimed, no torn chunk is "
+                     "ever visible under a valid digest",
+    },
+    "ckpt.worker.kill_mid_save": {
+        "layer": "ckpt",
+        "kinds": {"kill", "error"},
+        "desc": "a worker between arrays of its shard save (its part is never acked)",
+        "exercises": "coordinator commit protocol: missing ack discards the "
+                     "whole attempt, idempotent chunks already written are "
+                     "reclaimed unless an older manifest shares them",
+    },
+    "ckpt.publish.swap": {
+        "layer": "ckpt",
+        "kinds": {"delay", "error"},
+        "desc": "a replica about to hot-swap fetched+verified weights in place",
+        "exercises": "delay: old weights keep serving until the swap completes "
+                     "(no torn read); error: failed swap keeps old weights and "
+                     "retries on the next publish/poll",
+    },
     # -- L1: controller ---------------------------------------------------
     "controller.heartbeat": {
         "layer": "controller",

@@ -136,6 +136,15 @@ class Config:
     qos_max_concurrency: int = 1024
     qos_initial_concurrency: int = 64
     qos_adapt_interval_s: float = 0.5
+    # --- checkpoint & weight-publication plane (ray_tpu/ckpt/) ---
+    # Content-addressed chunk size for sharded saves. Matches the pull
+    # path's chunk granularity by default: one checkpoint chunk is one
+    # ranged read on restore, one transfer unit when it moves cross-host.
+    ckpt_chunk_size: int = 4 * 1024 * 1024
+    # Recovery cadence for replica weight subscriptions: the pubsub push is
+    # the fast path, this poll catches replicas whose subscription missed a
+    # publish (controller restart, dropped conn).
+    ckpt_poll_interval_s: float = 2.0
     # --- chaos (deterministic fault injection; see ray_tpu/chaos/) ---
     # JSON FaultSchedule spec ({"seed": N, "rules": [...]}) armed in EVERY
     # process of the session: the head pushes it with the rest of the config

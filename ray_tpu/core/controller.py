@@ -190,6 +190,16 @@ class Controller:
         # that buffer no longer loses live-task state.
         self.task_index: dict[tuple[str, int], dict] = {}
         self.tasks_evicted = 0  # index records dropped by the bound
+        # Checkpoint registry (ckpt plane): every save attempt's outcome
+        # (committed AND aborted — an invisible failed save is a debugging
+        # session), plus the per-channel latest-committed pointer that
+        # drives weight publication. Derivable from shared storage, so NOT
+        # in the snapshot: a restarted controller re-learns ids as savers
+        # re-register and subscribers fall back to their poll path.
+        self.ckpt_registry: dict[str, dict] = {}
+        self.ckpt_channels: dict[str, dict] = {}
+        self.ckpt_evicted = 0  # registry rows dropped by the bound
+        self.MAX_CKPT_REGISTRY = 512
         self._dirty = False
         # Actors restored from a snapshot as ALIVE/RESTARTING must be
         # re-confirmed by their daemon's re-registration within the grace
@@ -908,6 +918,44 @@ class Controller:
         ]
         return {"nodes": list(await asyncio.gather(*(one(n) for n in live)))}
 
+    # -- checkpoint registry & weight publication (ckpt plane) -----------
+    def handle_ckpt_register(self, conn, p):
+        """Record one save attempt's outcome. Committed summaries carrying a
+        channel move that channel's latest pointer and fan out over pubsub
+        (``ckpt:<channel>``) — the weight-publication trigger."""
+        s = dict(p["summary"])
+        self.ckpt_registry[s["ckpt_id"]] = s
+        while len(self.ckpt_registry) > self.MAX_CKPT_REGISTRY:
+            self.ckpt_registry.pop(next(iter(self.ckpt_registry)))
+            self.ckpt_evicted += 1
+        self._event("checkpoint_" + s.get("status", "committed"),
+                    ckpt_id=s["ckpt_id"], step=s.get("step"),
+                    channel=s.get("channel", ""))
+        channel = s.get("channel")
+        if channel and s.get("status") == "committed":
+            self.ckpt_channels[channel] = s
+            self.publish("ckpt:" + channel, s["ckpt_id"], s)
+        return True
+
+    def handle_ckpt_list(self, conn, p):
+        """Registry rows, newest first, PR-4 list conventions (server-side
+        filters + explicit truncation markers)."""
+        channel = p.get("channel")
+        status = p.get("status")
+        matched = [
+            s for s in reversed(list(self.ckpt_registry.values()))
+            if (not channel or s.get("channel") == channel)
+            and (not status or s.get("status") == status)
+        ]
+        out = self._truncate(matched, int(p.get("limit", 100)))
+        out["checkpoints"] = out.pop("items")
+        out["evicted"] = self.ckpt_evicted
+        out["channels"] = {c: s["ckpt_id"] for c, s in self.ckpt_channels.items()}
+        return out
+
+    def handle_ckpt_latest(self, conn, p):
+        return self.ckpt_channels.get(p["channel"])
+
     # -- metrics aggregation (ray.util.metrics equivalent pipeline) ------
     def handle_report_metrics(self, conn, p):
         self.metrics_by_reporter[p["reporter"]] = (time.monotonic(), p["series"])
@@ -974,6 +1022,10 @@ class Controller:
             out.append(rec("state.task_index.evicted_total", "counter",
                            self.tasks_evicted, {},
                            "task state records dropped by the index bound"))
+        if self.ckpt_evicted:
+            out.append(rec("state.ckpt_registry.evicted_total", "counter",
+                           self.ckpt_evicted, {},
+                           "checkpoint registry rows dropped by the bound"))
         if self.events_dropped:
             out.append(rec("events_dropped_total", "counter", self.events_dropped,
                            {"where": "controller"}, "control events lost to log trims"))

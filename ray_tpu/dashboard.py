@@ -25,7 +25,7 @@ h3{margin-bottom:0.1em}.muted{color:#777;font-size:0.85em}
 <a href=/api/cluster>cluster</a> · <a href=/api/tasks>tasks</a> ·
 <a href=/api/actors>actors</a> · <a href=/api/objects>objects</a> ·
 <a href=/api/summary>summary</a> · <a href=/api/memory>memory</a> ·
-<a href=/api/events>events</a> ·
+<a href=/api/events>events</a> · <a href=/api/checkpoints>checkpoints</a> ·
 <a href=/api/metrics>metrics</a> · <a href=/api/traces>traces</a> ·
 <a href=/api/jobs>jobs</a> · <a href=/metrics>prometheus</a> ·
 task filters: <code>/api/tasks?state=RUNNING&fn=NAME&node=ID&limit=50</code> ·
@@ -100,6 +100,17 @@ def _payload(path: str):
             return _state.list_objects(node=q.get("node"), limit=limit)
         if u.path == "/api/summary":
             return _state.summary_tasks(job=q.get("job"))
+    if path.startswith("/api/checkpoints"):
+        # Checkpoint-plane registry (ckpt manifests + publication channels):
+        # ?channel=NAME&status=committed|aborted&limit=N
+        from urllib.parse import parse_qs, urlsplit
+
+        from ray_tpu import state as _state
+
+        q = {k: v[0] for k, v in parse_qs(urlsplit(path).query).items()}
+        return _state.list_checkpoints(channel=q.get("channel"),
+                                       status=q.get("status"),
+                                       limit=int(q.get("limit", 100)))
     if path == "/api/memory":
         from ray_tpu import state as _state
 

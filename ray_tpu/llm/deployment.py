@@ -37,7 +37,8 @@ class LLMServer:
     """
 
     def __init__(self, model_config: dict, engine_config: Optional[dict] = None,
-                 warmup_buckets: Optional[tuple] = None, params=None):
+                 warmup_buckets: Optional[tuple] = None, params=None,
+                 weights_channel: Optional[str] = None):
         import jax
 
         from ray_tpu.llm.engine import EngineConfig, LLMEngine
@@ -74,8 +75,34 @@ class LLMServer:
         self._aborts: set[str] = set()
         self._counter = 0
         self._stop = False
+        # Weight hot-swap gate: step() and set_params() exclude each other,
+        # so a swap lands between engine iterations — in-flight batches
+        # finish on the old weights, no request ever reads a mixed tree.
+        self._swap_lock = threading.Lock()
+        self._weights_sub = None
+        if weights_channel:
+            # ckpt publication plane: subscribe this replica to the named
+            # checkpoint channel; committed manifests hot-swap in place
+            # (fetch + digest-verify happen OFF the swap lock).
+            from ray_tpu.ckpt import WeightSubscriber
+
+            self._weights_sub = WeightSubscriber(weights_channel, self._swap_weights)
         self._thread = threading.Thread(target=self._loop, name="llm-engine", daemon=True)
         self._thread.start()
+
+    def _swap_weights(self, tree, summary):
+        with self._swap_lock:
+            self.engine.set_params(tree)
+
+    def apply_weights(self, tree) -> bool:
+        """Push-style weight refresh (tests / manual rollout): same gate as
+        the subscription path."""
+        self._swap_weights(tree, None)
+        return True
+
+    def weights_version(self) -> Optional[str]:
+        sub = self._weights_sub
+        return sub.current_version if sub is not None else None
 
     def _loop(self):
         while not self._stop:
@@ -84,11 +111,12 @@ class LLMServer:
                 if not aborts and not self.engine.has_work():
                     self._cond.wait(timeout=0.05)
                     continue
-            for rid in aborts:
-                self.engine.abort(rid)
-            if not self.engine.has_work():
-                continue
-            events = self.engine.step()
+            with self._swap_lock:
+                for rid in aborts:
+                    self.engine.abort(rid)
+                if not self.engine.has_work():
+                    continue
+                events = self.engine.step()
             if not events:
                 continue
             with self._cond:
@@ -267,17 +295,21 @@ class LLMServer:
 
     def __raytpu_exit__(self):
         self._stop = True
+        if self._weights_sub is not None:
+            self._weights_sub.stop()
 
 
 def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
                   num_replicas: int = 1, max_ongoing_requests: Optional[int] = None,
                   warmup_buckets: Optional[tuple] = None,
                   ray_actor_options: Optional[dict] = None,
-                  params=None):
+                  params=None, weights_channel: Optional[str] = None):
     """Build a serve application serving this model. max_ongoing_requests
     defaults to the engine's slot count (router admission == engine capacity).
     params: trained weights — a param tree or an ObjectRef to one (the
-    train->serve handoff; sharded trees move per-shard, see LLMServer)."""
+    train->serve handoff; sharded trees move per-shard, see LLMServer).
+    weights_channel: subscribe every replica to this named checkpoint
+    channel — committed manifests hot-swap weights in place, no restart."""
     from ray_tpu import serve
     from ray_tpu.llm.engine import EngineConfig
 
@@ -297,4 +329,4 @@ def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
         max_ongoing_requests=max_ongoing_requests or ec.max_slots,
         ray_actor_options=aopts,
     )
-    return dep.bind(model_config, engine_config, warmup_buckets, params)
+    return dep.bind(model_config, engine_config, warmup_buckets, params, weights_channel)

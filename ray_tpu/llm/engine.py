@@ -283,6 +283,7 @@ class LLMEngine:
             param_shardings = logical_sharding(
                 self.mesh, ShardingStrategy.tp(), param_logical_axes(cfg)
             )
+        self._param_shardings = param_shardings  # kept for hot-swap resharding
         if params is not None:
             # Externally-supplied weights (checkpoint load): reshard per-leaf.
             self.params = (
@@ -782,6 +783,21 @@ class LLMEngine:
             )
         self.waiting.append(
             (req_id, np.asarray(tokens, np.int32), sampling, time.perf_counter())
+        )
+
+    def set_params(self, params) -> None:
+        """In-place weight hot-swap (ckpt publication plane): reshard the
+        new tree onto this engine's layout and flip the pointer. The caller
+        must exclude step() for the duration (LLMServer holds its swap
+        lock), so an in-flight batch finishes entirely on the old weights
+        and the next step reads entirely the new — never a mix. KV cache is
+        kept: a fine-tuned refresh of the same model keeps generating
+        coherently; swapping an unrelated model needs a redeploy."""
+        import jax
+
+        self.params = (
+            jax.device_put(params, self._param_shardings)
+            if self._param_shardings else jax.device_put(params)
         )
 
     def abort(self, req_id: str) -> None:

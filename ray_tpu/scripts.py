@@ -510,6 +510,19 @@ def cmd_list(args) -> None:
             [j["job_id"], j["status"], j["entrypoint"][:48]]
             for j in JobSubmissionClient().list_jobs()
         ])
+    elif kind == "checkpoints":
+        # --fn filters by publication channel; --state by committed/aborted.
+        out = state.list_checkpoints(channel=args.fn, status=args.state,
+                                     limit=args.limit)
+        rows = [
+            [c["ckpt_id"], c.get("step", "-"), c.get("channel") or "-",
+             c.get("status", "?"), f"{c.get('bytes_total', 0) / 1e6:.1f}",
+             f"{c.get('dedup_ratio', 0.0) * 100:.0f}%", c.get("workers", 1)]
+            for c in out["checkpoints"]
+        ]
+        live = " ".join(f"{ch}->{cid}" for ch, cid in sorted(out.get("channels", {}).items()))
+        _rows("checkpoints", ["ckpt_id", "step", "channel", "status", "MB", "dedup", "workers"],
+              rows, note=_trunc_note(out, len(rows)) or (f"live: {live}" if live else ""))
 
 
 def cmd_summary(args) -> None:
@@ -683,12 +696,12 @@ def cmd_logs(args) -> None:
 
 
 def add_state_parsers(sub) -> None:
-    lp = sub.add_parser("list", help="list tasks/actors/objects/nodes/workers/pgs/jobs")
+    lp = sub.add_parser("list", help="list tasks/actors/objects/nodes/workers/pgs/jobs/checkpoints")
     lp.add_argument("kind", choices=["tasks", "actors", "objects", "nodes",
-                                     "workers", "pgs", "jobs"])
+                                     "workers", "pgs", "jobs", "checkpoints"])
     lp.add_argument("--state", default=None,
                     help="filter by FSM state (tasks: RUNNING, FINISHED, ...; "
-                         "actors: ALIVE, DEAD, ...)")
+                         "actors: ALIVE, DEAD, ...; checkpoints: committed, aborted)")
     lp.add_argument("--node", default=None, help="filter by node id prefix")
     lp.add_argument("--fn", default=None,
                     help="filter by function/actor-name substring")

@@ -33,6 +33,7 @@ __all__ = [
     "get_task",
     "get_task_events",
     "list_actors",
+    "list_checkpoints",
     "list_nodes",
     "list_objects",
     "list_tasks",
@@ -126,6 +127,20 @@ def memory_summary(limit: int = 200, include_driver: bool = True) -> dict:
         core = _core()
         out["driver"] = core.memory_summary(limit=limit)
     return out
+
+
+def list_checkpoints(channel: Optional[str] = None, status: Optional[str] = None,
+                     limit: int = 100) -> dict:
+    """Checkpoint-plane registry, newest first: ``{"checkpoints": [{"ckpt_id",
+    "step", "channel", "status" (committed|aborted), "bytes_total",
+    "dedup_ratio", ...}], "total", "truncated", "evicted", "channels"}``.
+    ``channels`` maps each publication channel to its live ckpt_id."""
+    p: dict = {"limit": int(limit)}
+    if channel:
+        p["channel"] = channel
+    if status:
+        p["status"] = status
+    return _call("ckpt_list", p)
 
 
 def get_task_events(since: Optional[int] = None, limit: int = 20000) -> dict | list:

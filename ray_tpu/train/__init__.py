@@ -13,6 +13,7 @@ from ray_tpu.train.session import (
     get_context,
     get_dataset_shard,
     report,
+    save_pytree_async,
 )
 from ray_tpu.train.scaling_policy import (
     ElasticScalingPolicy,
@@ -49,4 +50,5 @@ __all__ = [
     "load_pytree",
     "report",
     "save_pytree",
+    "save_pytree_async",
 ]

@@ -5,6 +5,13 @@ Role-equivalent to the reference's ray.train Checkpoint (train/_checkpoint.py:56
 (train/v2/_internal/execution/checkpoint/checkpoint_manager.py:72 — top-K
 retention keyed on a score attribute). Sharded-array state goes through
 orbax (save_pytree/load_pytree) so a mesh-sharded train state round-trips.
+
+save_pytree is the SYNCHRONOUS path (blocks the step on
+wait_until_finished). The checkpoint & weight-publication plane
+(ray_tpu/ckpt/) is the async alternative: double-buffered sharded saves,
+content-addressed dedup, resharded restore, serve hot-swap — a plane-saved
+checkpoint folds into this manager's retention via manifest_ref dirs
+(see CheckpointManager._release_manifest).
 """
 from __future__ import annotations
 
@@ -14,7 +21,14 @@ import os
 import shutil
 import tempfile
 import time
+import uuid
 from typing import Any, Optional
+
+from ray_tpu.util import metrics as _metrics
+
+_evicted_total = _metrics.Counter(
+    "train.checkpoint.evicted_total",
+    "checkpoints deleted by top-K retention (manager-side eviction)")
 
 
 class Checkpoint:
@@ -68,11 +82,18 @@ class CheckpointManager:
     """Tracks reported checkpoints under storage_path, keeps top-K."""
 
     def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
-                 score_attribute: Optional[str] = None, score_order: str = "max"):
+                 score_attribute: Optional[str] = None, score_order: str = "max",
+                 manifest_store=None):
         self.storage_path = storage_path
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.score_order = score_order
+        # ckpt-plane fold: a registered dir saved through the checkpoint
+        # plane carries a manifest_ref.json naming its manifest; evicting
+        # it releases the manifest's chunk refcounts so only chunks no
+        # surviving checkpoint references are deleted (ckpt/manifest.py).
+        self.manifest_store = manifest_store
+        self.evicted_total = 0
         self._index = 0
         # list of (score, index, Checkpoint); score None -> recency ordering
         self._checkpoints: list[tuple[Any, int, Checkpoint]] = []
@@ -96,6 +117,11 @@ class CheckpointManager:
                 for c in st["checkpoints"]
                 if os.path.isdir(c["path"])
             ]
+            if len(self._checkpoints) != len(st["checkpoints"]):
+                # Dangling entries: an eviction that crashed after rmtree
+                # but before the index repersisted. Filter-and-repersist so
+                # a later crash/restart can't resurrect them a second time.
+                self._save_state()
         except (OSError, ValueError, KeyError):
             pass
 
@@ -132,7 +158,16 @@ class CheckpointManager:
             if src.startswith(staging_root + os.sep) and os.path.isdir(src):
                 os.replace(src, dest)
             else:
-                shutil.copytree(src, dest, dirs_exist_ok=True)
+                # Out-of-storage adoption: copy into staging first, then one
+                # atomic rename — a crash mid-copy leaves only .staging
+                # garbage (swept at startup), never a half-written
+                # checkpoint_NNNNNN dir a reload would adopt as valid.
+                tmp = os.path.join(staging_root, f"reg-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+                os.makedirs(staging_root, exist_ok=True)
+                shutil.copytree(src, tmp)
+                os.replace(tmp, dest)
+                with contextlib.suppress(OSError):
+                    os.rmdir(staging_root)  # only when no other stage is live
         ckpt = Checkpoint(dest, dict(metrics))
         score = metrics.get(self.score_attribute) if self.score_attribute else None
         self._checkpoints.append((score, self._index, ckpt))
@@ -156,8 +191,45 @@ class CheckpointManager:
         keep = ranked[: self.num_to_keep]
         for s, i, c in self._checkpoints:
             if (s, i, c) not in keep:
+                self._release_manifest(c.path)
                 shutil.rmtree(c.path, ignore_errors=True)
+                self.evicted_total += 1
+                _evicted_total.inc()
         self._checkpoints = [t for t in self._checkpoints if t in keep]
+
+    def _release_manifest(self, path: str) -> None:
+        """Chunk-refcount fold for plane-saved checkpoints: the evicted dir
+        may be a thin pointer at a manifest — release it so unreferenced
+        chunks are reclaimed (shared chunks survive). Without an attached
+        manifest_store, one is opened from the ref's storage root: the
+        TrainController evicts in a different process than the worker
+        savers that commit, so the fold cannot assume a shared instance."""
+        try:
+            with open(os.path.join(path, "manifest_ref.json")) as f:
+                ref = json.load(f)
+            ckpt_id = ref["ckpt_id"]
+        except (OSError, ValueError, KeyError):
+            return
+        store = self.manifest_store
+        if store is None:
+            root = ref.get("storage")
+            if not root:
+                return
+            try:
+                from ray_tpu.ckpt import ManifestStore
+
+                # Fresh store per release, never cached: refcounts are
+                # derived from the committed manifests on disk, and savers
+                # in other processes commit between evictions — a cached
+                # scan would under-count and delete chunks a newer
+                # manifest references.
+                store = ManifestStore(root)
+            except Exception:
+                return
+        try:
+            store.release(ckpt_id)
+        except Exception:
+            pass  # chunk GC is best-effort; verify() surfaces leaks
 
     @property
     def latest(self) -> Optional[Checkpoint]:

@@ -37,6 +37,7 @@ class TrainSession:
         self.reports: "queue.Queue[dict]" = queue.Queue()
         self.stop_event = threading.Event()
         self._report_seq = 0
+        self._async_saver = None  # lazy ckpt-plane AsyncSaver (save_pytree_async)
 
     # -- user API ----------------------------------------------------------
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
@@ -53,6 +54,68 @@ class TrainSession:
             # tempdir (reference: context.py:268 persists inside report()).
             entry["checkpoint_dir"] = self._persist(checkpoint)
         self.reports.put(entry)
+
+    def save_pytree_async(self, tree, metrics: dict, *, mesh: Optional[dict] = None):
+        """Checkpoint-plane save: snapshot this worker's shards off the step
+        path (ray_tpu/ckpt AsyncSaver double buffer), report() the metrics
+        immediately, and hand the controller a manifest_ref dir once the
+        background commit lands — the plane's manifests fold into the
+        CheckpointManager's top-K retention through that ref. Returns the
+        SaveFuture (result() = the committed Manifest).
+
+        Rank-0-persists convention, like report(checkpoint=...): SPMD state
+        is identical everywhere, so ONE rank saves and its manifest covers
+        the full arrays (each commit here is a single-worker attempt). A
+        gang whose ranks hold genuinely DISJOINT shards needs the
+        coordinator protocol instead — every rank ckpt.write_part()s its
+        local shards and one process ckpt.commit_parts()s the merged
+        manifest after all ranks ack."""
+        if self._async_saver is None:
+            from ray_tpu.ckpt import AsyncSaver
+
+            self._async_saver = AsyncSaver(self.storage_path)
+        self._report_seq += 1
+        seq = self._report_seq
+        fut = self._async_saver.save_async(seq, tree, mesh=mesh, meta=dict(metrics))
+        # Metrics ship NOW; the checkpoint_dir rides a SECOND report with
+        # the same seq once the commit lands (_absorb_reports merges by
+        # seq), so the controller never sees — and never adopts — a staging
+        # dir whose manifest_ref is still being written. An aborted save
+        # ships no dir at all: restore falls back to the previous
+        # checkpoint, the torn-report contract report() already has.
+        self.reports.put({"metrics": dict(metrics), "seq": seq,
+                          "world_rank": self.world_rank})
+        fut.add_done_callback(self._ref_reporter(seq, dict(metrics)))
+        return fut
+
+    def _ref_reporter(self, seq: int, metrics: dict):
+        """Done-callback for a plane save: materialize the manifest-ref
+        staging dir and queue the checkpoint report. Runs on the saver's
+        writer thread BEFORE fut.result() unblocks, so a train fn that
+        waits on its last save is guaranteed the report is in the queue
+        when it returns (the controller's final poll absorbs it)."""
+
+        def _on_done(fut):
+            import json
+
+            if fut._error is not None:
+                return  # aborted attempt: no dir, nothing to adopt
+            manifest = fut._result
+            dest = os.path.join(
+                os.path.abspath(self.storage_path), ".staging",
+                f"ckpt-r{self.world_rank}-s{seq}-{uuid.uuid4().hex[:8]}")
+            os.makedirs(dest, exist_ok=True)
+            tmp = os.path.join(dest, ".manifest_ref.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"ckpt_id": manifest["ckpt_id"],
+                           "step": manifest.get("step"),
+                           "storage": manifest.get("storage")}, f)
+            os.replace(tmp, os.path.join(dest, "manifest_ref.json"))
+            self.reports.put({"metrics": metrics, "seq": seq,
+                              "world_rank": self.world_rank,
+                              "checkpoint_dir": dest})
+
+        return _on_done
 
     def _persist(self, checkpoint: Checkpoint) -> str:
         """Copy a node-local checkpoint dir into shared storage; returns the
@@ -152,3 +215,12 @@ def get_dataset_shard(name: str = "train"):
     if s is None:
         raise RuntimeError("get_dataset_shard() called outside a train worker")
     return s.get_dataset_shard(name)
+
+
+def save_pytree_async(tree, metrics: dict, mesh: Optional[dict] = None):
+    """Checkpoint-plane async save from inside a train fn (see
+    TrainSession.save_pytree_async)."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.save_pytree_async() called outside a train worker")
+    return s.save_pytree_async(tree, metrics, mesh=mesh)
