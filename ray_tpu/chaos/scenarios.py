@@ -543,6 +543,136 @@ def _scn_overload_storm(seed: int, quick: bool) -> dict:
     }
 
 
+def _scn_autoscale_flap(seed: int, quick: bool) -> dict:
+    """Scale plane under slow capacity arrival: every replica start is
+    chaos-delayed (site scale.replica.start) while sustained load drives the
+    autoscaler up from min_replicas. Invariants pinned here, beyond the
+    standard battery:
+
+    * the policy upscales (an applied upscale decision exists and the
+      replica set actually grows past min_replicas) — the QoS/demand
+      signals really request capacity;
+    * NO FLAP: the applied decision sequence contains no
+      upscale->downscale (or reverse) pair closer than the policy's
+      cooldown window — a replica being slow to arrive must not read as
+      satisfied demand and oscillate the target;
+    * requests keep succeeding across the scale-out (no hard failures).
+    """
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import ray_tpu as rt
+    from ray_tpu.core.api import Cluster, init
+
+    cooldown_s = 2.0
+    cfg = _fresh_config()
+    cfg.chaos_spec = json.dumps({
+        "seed": seed,
+        # Every replica start stalls ~1s: the upscale's capacity arrives
+        # late, exactly the window a flapping policy would reverse itself in.
+        "rules": [{"site": "scale.replica.start", "kind": "delay",
+                   "delay_s": 1.0}],
+    })
+    _plan.install_from_json(cfg.chaos_spec)
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=6)
+    init(address=cluster.address, config=cfg)
+    from ray_tpu import serve
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    @serve.deployment(name="Slowstart", max_ongoing_requests=2,
+                      autoscaling_config=AutoscalingConfig(
+                          min_replicas=1, max_replicas=3,
+                          target_ongoing_requests=1.0,
+                          upscale_delay_s=0.3, downscale_delay_s=0.6,
+                          cooldown_s=cooldown_s))
+    class Slowstart:
+        def __call__(self, request):
+            time.sleep(0.05)  # per-request service time: load builds depth
+            return "ok"
+
+    serve.run(Slowstart.bind(), name="flap", route_prefix="/flap")
+    port = serve.http_port()
+    ctl = rt.get_actor("__serve_controller__", namespace="serve")
+
+    duration = 6.0 if quick else 10.0
+    stop_at = time.monotonic() + duration
+    lock = threading.Lock()
+    codes: dict = {}
+
+    def flood():
+        while time.monotonic() < stop_at:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/flap", data=b"{}", method="POST",
+                headers={"x-priority": "interactive", "x-tenant": "user",
+                         "x-request-timeout-s": "5"})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    code = resp.status
+                    resp.read()
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.read()
+            except Exception:
+                code = -1
+            with lock:
+                codes[code] = codes.get(code, 0) + 1
+
+    threads = [threading.Thread(target=flood) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 120)
+    _require(all(not t.is_alive() for t in threads), "load threads wedged")
+    # Let the reconcile loop catch up with the final target.
+    deadline = time.monotonic() + 30
+    state = {}
+    while time.monotonic() < deadline:
+        state = rt.get(ctl.get_serve_state.remote(), timeout=30)
+        dep = state["apps"]["flap"]["Slowstart"]
+        if len(dep["replicas"]) >= dep["target"]:
+            break
+        time.sleep(0.3)
+    dep = state["apps"]["flap"]["Slowstart"]
+    decisions = dep["decisions"]
+    applied = [d for d in decisions if d.get("applied")]
+    ups = [d for d in applied if d["action"] == "upscale"]
+    _require(bool(ups), f"no applied upscale under sustained load: {decisions}")
+    _require(len(dep["replicas"]) >= 2,
+             f"replica set never grew past min_replicas: {dep}")
+    # The flap assertion: consecutive applied decisions never reverse
+    # direction inside the cooldown window.
+    for a, b in zip(applied, applied[1:]):
+        if a["action"] != b["action"]:
+            gap = b["ts"] - a["ts"]
+            _require(gap >= cooldown_s,
+                     f"policy flapped {a['action']}->{b['action']} after "
+                     f"{gap:.2f}s < cooldown {cooldown_s}s: {applied}")
+    _require(codes.get(200, 0) > 0, f"no request ever succeeded: {codes}")
+    _require(codes.get(-1, 0) + codes.get(500, 0) == 0,
+             f"hard failures during scale-out: {codes}")
+    from ray_tpu.serve.handle import _reset_registry
+
+    _reset_registry()  # park router threads before the invariant battery
+    return {
+        "cluster": cluster,
+        "details": {
+            "codes": {str(c): n for c, n in codes.items()},
+            "replicas": len(dep["replicas"]),
+            "target": dep["target"],
+            "applied_decisions": [
+                {"action": d["action"], "to": d["to"], "reason": d["reason"]}
+                for d in applied
+            ],
+        },
+        # Replica starts happen in the ServeController's worker process:
+        # its injections reach /metrics via the reporter, not this driver.
+        "min_injections": 0,
+        "min_metric_injections": 1,
+    }
+
+
 def _scn_ckpt_kill_mid_save(seed: int, quick: bool) -> dict:
     """Checkpoint plane under fire: a worker dies mid sharded save, a chunk
     write fails in a later attempt, and the publish swap is delayed. The
@@ -689,6 +819,7 @@ SCENARIOS: dict = {
     "mac_corrupt_storm": _scn_mac_corrupt_storm,
     "tpu_preempt_drain": _scn_tpu_preempt_drain,
     "overload_storm": _scn_overload_storm,
+    "autoscale_flap": _scn_autoscale_flap,
     "ckpt_kill_mid_save": _scn_ckpt_kill_mid_save,
 }
 

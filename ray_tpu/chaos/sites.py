@@ -111,6 +111,16 @@ SITES: dict = {
                      "expiry at every hop, interactive goodput under overload "
                      "(scenario overload_storm)",
     },
+    "scale.replica.start": {
+        "layer": "serve",
+        "kinds": {"delay", "error"},
+        "desc": "the serve controller about to start one replica (delayed "
+                "or failed startup: slow provisioning, image pulls)",
+        "exercises": "scale plane under slow capacity arrival: the policy's "
+                     "flip cooldown (no upscale->downscale oscillation while "
+                     "a replica is slow to arrive — scenario autoscale_flap), "
+                     "reconcile retry of failed starts",
+    },
     # -- L5: checkpoint & weight-publication plane ------------------------
     "ckpt.chunk.write": {
         "layer": "ckpt",

@@ -161,6 +161,11 @@ class Controller:
         self.pending_actors: list = []  # ActorRecords parked until placeable
         self.object_dir: dict[bytes, set[str]] = {}  # oid bytes -> node ids
         self.object_sizes: dict[bytes, int] = {}
+        # External pending demand (scale plane): control planes above the
+        # scheduler (the ServeController's unplaceable replicas) register
+        # resource footprints here; the autoscaler treats them exactly like
+        # pending task/actor demand. source -> [{"demand", "label_selector"}].
+        self.external_demand: dict[str, list] = {}
         self.subscribers: dict[str, set] = {}  # channel -> conns
         self.jobs: dict[str, dict] = {}
         self._job_counter = 0
@@ -605,11 +610,36 @@ class Controller:
             for pg in self.pgs.values()
             if pg.state == "PENDING"
         ]
+        for items in self.external_demand.values():
+            for it in items:
+                pending.append({
+                    "demand": it.get("demand") or {},
+                    "label_selector": it.get("label_selector") or {},
+                    "kind": "external",
+                })
         return {
             "pending": pending,
             "pending_gangs": gang,
             "nodes": self._node_table(),
         }
+
+    def handle_set_external_demand(self, conn, p):
+        """Register (or clear, with an empty items list) one source's
+        external pending demand for the autoscaler (scale plane: the serve
+        controller's unplaceable replica footprints)."""
+        source = p.get("source") or ""
+        items = p.get("items") or []
+        if not source:
+            return {"ok": False, "error": "source required"}
+        if items:
+            self.external_demand[source] = [
+                {"demand": dict(it.get("demand") or {}),
+                 "label_selector": dict(it.get("label_selector") or {})}
+                for it in items
+            ]
+        else:
+            self.external_demand.pop(source, None)
+        return {"ok": True, "sources": len(self.external_demand)}
 
     # -- task-event aggregation (TaskEventBuffer -> GcsTaskManager equiv) -
     def handle_report_task_events(self, conn, p):

@@ -26,6 +26,7 @@ h3{margin-bottom:0.1em}.muted{color:#777;font-size:0.85em}
 <a href=/api/actors>actors</a> · <a href=/api/objects>objects</a> ·
 <a href=/api/summary>summary</a> · <a href=/api/memory>memory</a> ·
 <a href=/api/events>events</a> · <a href=/api/checkpoints>checkpoints</a> ·
+<a href=/api/serve>serve</a> ·
 <a href=/api/metrics>metrics</a> · <a href=/api/traces>traces</a> ·
 <a href=/api/jobs>jobs</a> · <a href=/metrics>prometheus</a> ·
 task filters: <code>/api/tasks?state=RUNNING&fn=NAME&node=ID&limit=50</code> ·
@@ -132,6 +133,18 @@ def _payload(path: str):
             "list_traces",
             {"limit": int((q.get("limit") or ["100"])[0]), "q": (q.get("q") or [""])[0]},
         ))
+    if path == "/api/serve":
+        # Scale-plane view: per-deployment replica sets, demand estimates,
+        # and the autoscaler's decision log (serve/controller.py
+        # get_serve_state).
+        import ray_tpu as rt
+        from ray_tpu.serve.handle import CONTROLLER_NAME, SERVE_NAMESPACE
+
+        try:
+            ctl = rt.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        except ValueError:
+            return {"error": "serve controller not running", "apps": {}}
+        return rt.get(ctl.get_serve_state.remote(), timeout=10)
     if path == "/api/metrics":
         return core._run(core.controller.call("get_metrics", {}))
     if path == "/api/jobs":

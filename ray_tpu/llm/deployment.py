@@ -303,17 +303,23 @@ def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
                   num_replicas: int = 1, max_ongoing_requests: Optional[int] = None,
                   warmup_buckets: Optional[tuple] = None,
                   ray_actor_options: Optional[dict] = None,
-                  params=None, weights_channel: Optional[str] = None):
+                  params=None, weights_channel: Optional[str] = None,
+                  autoscaling_config=None):
     """Build a serve application serving this model. max_ongoing_requests
     defaults to the engine's slot count (router admission == engine capacity).
     params: trained weights — a param tree or an ObjectRef to one (the
     train->serve handoff; sharded trees move per-shard, see LLMServer).
     weights_channel: subscribe every replica to this named checkpoint
-    channel — committed manifests hot-swap weights in place, no restart."""
+    channel — committed manifests hot-swap weights in place, no restart.
+    autoscaling_config: AutoscalingConfig (or kwargs dict) — replica count
+    then floats between min/max, driven by the scale plane's demand + QoS
+    signals (ray_tpu/scale/) instead of num_replicas."""
     from ray_tpu import serve
     from ray_tpu.llm.engine import EngineConfig
 
     ec = EngineConfig(**(engine_config or {}))
+    if isinstance(autoscaling_config, dict):
+        autoscaling_config = serve.AutoscalingConfig(**autoscaling_config)
     aopts = dict(ray_actor_options or {})
     if ec.tensor_parallel > 1:
         # Tensor-parallel replica: gang-schedule it onto a host advertising
@@ -328,5 +334,6 @@ def build_llm_app(model_config: dict, engine_config: Optional[dict] = None,
         num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests or ec.max_slots,
         ray_actor_options=aopts,
+        autoscaling_config=autoscaling_config,
     )
     return dep.bind(model_config, engine_config, warmup_buckets, params, weights_channel)

@@ -108,6 +108,14 @@ class EngineConfig:
     # sampling.TOPK_CAP for the nucleus-width caveat. Raise for workloads
     # sampling high-entropy distributions with top_p near 1.
     sample_topk_cap: int = 128
+    # Chunked prefill (paged layout only; vLLM's chunked-prefill idea on
+    # the tail-prefill program): a prompt whose un-cached span exceeds this
+    # many tokens prefills in page-aligned chunks of this size, ONE chunk
+    # per engine step, interleaved with the decode blocks — a 512-token
+    # prefill can no longer head-of-line-stall decoding slots for its whole
+    # length; decode stall per step is bounded by one chunk's compute.
+    # Must be a multiple of page_size. 0 = off (whole-prompt prefill).
+    chunked_prefill: int = 0
     # Prefix KV cache (paged layout only; reference: vLLM automatic prefix
     # caching + PrefixCacheAffinityRouter, prefix_aware_router.py:39). A
     # retired request's PROMPT pages stay in an LRU cache under CHAINED
@@ -134,6 +142,7 @@ class _Slot:
     emitted: list = dataclasses.field(default_factory=list)
     n_generated: int = 0  # dispatched count (values may still be on device)
     arrived_at: float = 0.0
+    prefill_pos: int = 0  # tokens already prefilled (chunked-prefill progress)
     first_token_at: Optional[float] = None
     stop_ids: tuple = ()  # per-request stop tokens (on top of engine eos)
     ignore_eos: bool = False
@@ -371,6 +380,20 @@ class LLMEngine:
         self.prefix_misses = 0
         if self.ec.prefix_cache and not self.paged:
             raise ValueError("prefix_cache requires kv_layout='paged'")
+        if self.ec.chunked_prefill:
+            if not self.paged:
+                raise ValueError("chunked_prefill requires kv_layout='paged'")
+            if self.ec.chunked_prefill % self.ec.page_size:
+                raise ValueError(
+                    f"chunked_prefill {self.ec.chunked_prefill} must be a "
+                    f"multiple of page_size {self.ec.page_size}"
+                )
+        # Slots mid chunked-prefill: slot index -> full prompt tokens. Their
+        # DEVICE length/page-table rows stay zeroed until the final chunk
+        # lands (the decode block's writes for them go to dead page 0), so
+        # decode interleaves with an in-progress prefill without scribbling
+        # on the pages the chunks are filling.
+        self._prefilling: dict[int, np.ndarray] = {}
         if self.paged:
             ps_ = self.ec.page_size
             n_pg_axes = (cfg.n_layers, cfg.kv_heads, ps_, cfg.head_dim)
@@ -434,6 +457,24 @@ class LLMEngine:
         # request's own reservation.
         total = min(prompt_len + max_tokens + self.ec.decode_block, self.ec.max_seq)
         return math.ceil(total / self.ec.page_size)
+
+    # -- device-mirror masking (chunked prefill) ---------------------------
+    def _masked_lengths(self) -> np.ndarray:
+        """Host lengths with mid-prefill slots zeroed: the decode block must
+        treat them as empty (writes land in dead page 0) until their final
+        chunk installs the real length."""
+        if not self._prefilling:
+            return self.lengths
+        m = self.lengths.copy()
+        m[list(self._prefilling)] = 0
+        return m
+
+    def _masked_page_tables(self) -> np.ndarray:
+        if not self._prefilling:
+            return self.page_tables
+        m = self.page_tables.copy()
+        m[list(self._prefilling)] = 0
+        return m
 
     # -- jitted programs ---------------------------------------------------
     def _prefill_impl(self, params, k_pages, v_pages, tokens, length, page_idxs, key, temp, top_p, top_k):
@@ -808,8 +849,8 @@ class LLMEngine:
         for i, s in enumerate(self.slots):
             if s is not None and s.req_id == req_id:
                 self._retire(i)
-                self.d_lengths = jnp.asarray(self.lengths)
-                self.d_page_tables = jnp.asarray(self.page_tables)
+                self.d_lengths = jnp.asarray(self._masked_lengths())
+                self.d_page_tables = jnp.asarray(self._masked_page_tables())
                 break
 
     def has_work(self) -> bool:
@@ -875,9 +916,13 @@ class LLMEngine:
         slot = self.slots[i]
         if slot is not None:
             kept: set = set()
-            if slot.prompt_tokens is not None and self.paged:
+            if (slot.prompt_tokens is not None and self.paged
+                    and i not in self._prefilling):
+                # A half-prefilled prompt never enters the prefix cache: its
+                # later pages hold no KV yet.
                 kept = self._cache_insert(slot)
             self.free_pages.extend(p for p in slot.pages if p not in kept)
+        self._prefilling.pop(i, None)
         self.slots[i] = None
         self.lengths[i] = 0
         self.page_tables[i, :] = 0
@@ -925,6 +970,8 @@ class LLMEngine:
         cache_hits: list[tuple[int, int]] = []  # (slot, last prompt token)
         tail_admitted: list[tuple[int, str, np.ndarray, int, int, float]] = []
         use_cache = self.paged and self.ec.prefix_cache
+        use_chunked = self.paged and self.ec.chunked_prefill > 0
+        chunk_size = self.ec.chunked_prefill
         for i in range(self.ec.max_slots):
             if not self.waiting or self.slots[i] is not None:
                 continue
@@ -997,12 +1044,30 @@ class LLMEngine:
                     self.prefix_hits += 1
                     self.lengths[i] = P - 1
                     cache_hits.append((i, int(tokens[-1])))
+                elif use_chunked and P - hit_len > chunk_size:
+                    # Partial hit with a long tail: chunk the tail too —
+                    # progress starts at the cached (page-aligned) prefix.
+                    self.prefix_partial_hits += 1
+                    self.lengths[i] = P
+                    self.slots[i].n_generated = 0
+                    self.slots[i].prefill_pos = hit_len
+                    self._prefilling[i] = np.asarray(tokens, np.int32)
                 else:
                     # Partial hit: prefill only the tail over the cached
                     # context (dispatched with the prefill groups below).
                     self.prefix_partial_hits += 1
                     self.lengths[i] = P
                     tail_admitted.append((i, req_id, tokens, hit_len, sp.max_tokens, arrived))
+            elif use_chunked and P > chunk_size:
+                # Chunked prefill: ONE chunk per step, interleaved with the
+                # decode blocks (phase 2c) — a long prompt can no longer
+                # stall every decoding slot for its whole prefill.
+                if use_cache:
+                    self.prefix_misses += 1
+                self.lengths[i] = P
+                self.slots[i].n_generated = 0
+                self.slots[i].prefill_pos = 0
+                self._prefilling[i] = np.asarray(tokens, np.int32)
             else:
                 if use_cache:
                     self.prefix_misses += 1
@@ -1080,8 +1145,62 @@ class LLMEngine:
             self.d_lengths = self.d_lengths.at[i].set(P)
             self.d_last = self.d_last.at[i].set(toks_dev[0])
             dispatched.append(([(i, req_id, tokens, None, _mt, arrived)], toks_dev))
-        if admitted or cache_hits or tail_admitted:
-            self.d_page_tables = jnp.asarray(self.page_tables)
+        # 2c. chunked prefill: advance every mid-prefill slot by ONE chunk —
+        # the interleave contract is at most one chunk of prefill compute
+        # PER IN-FLIGHT PREFILL between consecutive decode blocks, so a
+        # 512-token prompt arriving while others decode costs them
+        # chunk-sized stalls, not a full-prompt stall (a burst of N long
+        # prompts stalls decode N chunks per step — still bounded and
+        # spread, vs N whole prompts back to back). The final chunk samples
+        # the request's first token and installs the slot's device mirrors
+        # (until then its device rows stay zeroed: decode writes for it hit
+        # dead page 0).
+        chunk_dispatched = bool(self._prefilling)
+        for i in sorted(self._prefilling):
+            slot = self.slots[i]
+            tokens = self._prefilling[i]
+            P = len(tokens)
+            start = slot.prefill_pos
+            n_tok = min(chunk_size, P - start)
+            last_chunk = start + n_tok >= P
+            tail = tokens[start:start + n_tok]
+            tb = next(b for b in self.buckets if b >= n_tok)
+            j = start // ps
+            C = next(c for c in self.c_buckets if c >= max(j, 1))
+            padded = np.zeros(tb, np.int32)
+            padded[:n_tok] = tail
+            ctx = np.zeros(C, np.int32)
+            ctx[:j] = self.page_tables[i, :j]
+            n_tpg = tb // ps
+            tpg = np.zeros(n_tpg, np.int32)
+            m = min(n_tpg, self.ppseq - j)
+            tpg[:m] = self.page_tables[i, j:j + m]  # zeros past need -> dead sink
+            # Intermediate chunks mask at the chunk's end (all its tokens are
+            # real); the last chunk masks at the true prompt length and its
+            # sampled token is the request's first.
+            length = P if last_chunk else start + n_tok
+            self._key, sub = jax.random.split(self._key)
+            self.k_pages, self.v_pages, toks_dev = self._tail_prefill(tb, C)(
+                self.params, self.k_pages, self.v_pages,
+                jnp.asarray(padded), jnp.int32(start), jnp.int32(length),
+                jnp.asarray(ctx), jnp.asarray(tpg), sub,
+                jnp.asarray(self.samp_temps[i:i + 1]),
+                jnp.asarray(self.samp_top_ps[i:i + 1]),
+                jnp.asarray(self.samp_top_ks[i:i + 1]),
+            )
+            if last_chunk:
+                del self._prefilling[i]
+                slot.prefill_pos = P
+                slot.n_generated = 1
+                self.d_lengths = self.d_lengths.at[i].set(P)
+                self.d_last = self.d_last.at[i].set(toks_dev[0])
+                dispatched.append(
+                    ([(i, slot.req_id, tokens, None, slot.max_tokens,
+                       slot.arrived_at)], toks_dev))
+            else:
+                slot.prefill_pos = start + n_tok
+        if admitted or cache_hits or tail_admitted or chunk_dispatched:
+            self.d_page_tables = jnp.asarray(self._masked_page_tables())
             self.d_temps = jnp.asarray(self.samp_temps)
             self.d_top_ps = jnp.asarray(self.samp_top_ps)
             self.d_top_ks = jnp.asarray(self.samp_top_ks)
@@ -1103,8 +1222,11 @@ class LLMEngine:
                 }
                 retired |= self._maybe_finish(i, events)
         # 3. decode: one fused block over all slots. Queue pressure shrinks
-        # the block so the next admission wave starts sooner.
-        active = [i for i, s in enumerate(self.slots) if s is not None]
+        # the block so the next admission wave starts sooner. Slots mid
+        # chunked-prefill ride along masked (writes to dead page 0, tokens
+        # discarded) but do not drive the block's budget arithmetic.
+        active = [i for i, s in enumerate(self.slots)
+                  if s is not None and i not in self._prefilling]
         toks = None
         n = 0
         if active:
@@ -1116,7 +1238,7 @@ class LLMEngine:
                 # OR while any slot still owes its FIRST token (prefix-cache
                 # hits skip prefill; their TTFT is the first decode block —
                 # a full block would pay block_size steps of latency for it).
-                awaiting_first = any(
+                awaiting_first = bool(self._prefilling) or any(
                     self.slots[i] is not None and not self.slots[i].emitted
                     for i in active
                 )
@@ -1183,12 +1305,12 @@ class LLMEngine:
         if retired:
             # Re-sync device mirrors so retired slots stop advancing their
             # (now meaningless) lengths toward max_seq, and their writes land
-            # in the dead page.
-            self.d_lengths = jnp.asarray(self.lengths)
-            self.d_page_tables = jnp.asarray(self.page_tables)
+            # in the dead page. Mid-prefill slots stay masked.
+            self.d_lengths = jnp.asarray(self._masked_lengths())
+            self.d_page_tables = jnp.asarray(self._masked_page_tables())
             last = np.zeros(self.ec.max_slots, np.int32)
             for i, s in enumerate(self.slots):
-                if s is not None:
+                if s is not None and s.emitted:
                     last[i] = s.emitted[-1]
             self.d_last = jnp.asarray(last)
         return events

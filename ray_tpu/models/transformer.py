@@ -364,6 +364,26 @@ def _ce_from_logits(logits, targets, mask=None):
     return jnp.mean(nll)
 
 
+@jax.custom_vjp
+def _diff_barrier(xs):
+    """optimization_barrier with an explicit identity gradient: this jax
+    version has no differentiation rule for the primitive, and the barrier
+    is a pure scheduling hint — cotangents pass through unchanged (what
+    newer jax's built-in rule does too)."""
+    return lax.optimization_barrier(xs)
+
+
+def _diff_barrier_fwd(xs):
+    return lax.optimization_barrier(xs), None
+
+
+def _diff_barrier_bwd(_, g):
+    return (g,)
+
+
+_diff_barrier.defvjp(_diff_barrier_fwd, _diff_barrier_bwd)
+
+
 def _ce_chunked(x, lm_head, targets, mask, chunk: int):
     """Fused-style CE: the [B, S, V] logits are never materialized — a
     rematted scan computes each sequence chunk's logits [B, c, V], reduces
@@ -396,7 +416,7 @@ def _ce_chunked(x, lm_head, targets, mask, chunk: int):
         sl = slice(i * chunk, (i + 1) * chunk)
         x_i = x[:, sl]
         if i:
-            x_i, tot = lax.optimization_barrier((x_i, tot))
+            x_i, tot = _diff_barrier((x_i, tot))
         s_i, c_i = body(x_i, targets[:, sl], mask[:, sl])
         tot += s_i
         cnt += c_i

@@ -44,6 +44,13 @@ NEG_INF = -1e30
 # Reference implementation (numerical oracle + CPU fallback)
 # ---------------------------------------------------------------------------
 
+def _compiler_params(pltpu):
+    """The pallas TPU compiler-params class under either of its names:
+    jax renamed TPUCompilerParams -> CompilerParams across versions, and
+    these kernels must build on both."""
+    return getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
 def mha_reference(q, k, v, causal=True, scale=None, segment_ids=None):
     """q: [B, S, H, D]; k,v: [B, S, KV, D] (KV divides H) -> [B, S, H, D].
     Softmax in f32. segment_ids: optional [B, S] int; attention is masked to
@@ -189,7 +196,7 @@ def _fwd_pallas(q, k, v, seg, *, causal, scale, block_q, block_k, group, H, inte
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -378,7 +385,7 @@ def _bwd_pallas(res, g, *, causal, scale, block_q, block_k, group, H, KV, interp
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -410,7 +417,7 @@ def _bwd_pallas(res, g, *, causal, scale, block_q, block_k, group, H, KV, interp
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

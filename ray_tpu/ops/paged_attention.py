@@ -34,6 +34,11 @@ NEG_INF = -1e30
 # Reference implementation (numerical oracle + CPU path)
 # ---------------------------------------------------------------------------
 
+# One compat shim for the whole ops package (attention.py owns it): the
+# pallas TPU compiler-params class was renamed across jax versions.
+from ray_tpu.ops.attention import _compiler_params  # noqa: E402
+
+
 def paged_attention_reference(q, k_pages, v_pages, lengths, page_indices, scale=None):
     """q: [B, H, D]; k_pages/v_pages: [KV, P_total, ps, D]; lengths: [B]
     (valid token count per sequence, INCLUDING the current position);
@@ -140,7 +145,7 @@ def _paged_pallas(q, k_pages, v_pages, lengths, page_indices, *, scale, interpre
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, Gp, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

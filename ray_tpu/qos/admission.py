@@ -65,6 +65,13 @@ class AdmissionController:
         self._window_min: dict[int, float] = {}
         self._window_max_delay = 0.0
         self._saw_traffic = False
+        # -- scale-plane telemetry (ray_tpu/scale/signals.py): the LAST
+        # completed window's per-class minima, the limit's trajectory, and
+        # a cumulative shed tally — the signals that let the autoscaler
+        # REQUEST capacity instead of only shedding.
+        self.sheds_total = 0
+        self._last_window_min: dict[int, float] = {}
+        self._prev_limit = self.limit
 
     # -- the per-request surface ----------------------------------------
     def try_admit(self, rank: int) -> tuple[bool, float]:
@@ -78,6 +85,7 @@ class AdmissionController:
             cap = self.limit * _CLASS_CAPS[rank]
             occupancy = self.class_inflight[0] if rank == 0 else self.inflight
             if occupancy >= cap:
+                self.sheds_total += 1
                 return False, self._retry_after_locked()
             self.inflight += 1
             self.class_inflight[rank] += 1
@@ -107,6 +115,24 @@ class AdmissionController:
                     "class_inflight": list(self.class_inflight),
                     "target_delay_s": self.target_delay_s}
 
+    def telemetry(self) -> dict:
+        """The scale-plane feed (proxy -> ServeController ->
+        scale/signals.py): limit + its last-adaptation slope, the last
+        completed window's per-class delay minima (class NAMES as keys so
+        the fold never re-derives rank order), and the cumulative shed
+        tally (the estimator differentiates it into a rate)."""
+        with self._lock:
+            return {
+                "limit": self.limit,
+                "limit_trend": self.limit - self._prev_limit,
+                "inflight": self.inflight,
+                "target_delay_s": self.target_delay_s,
+                "delay_min_by_class": {
+                    PRIORITIES[r]: v for r, v in self._last_window_min.items()
+                },
+                "sheds_total": float(self.sheds_total),
+            }
+
     # -- adaptation ------------------------------------------------------
     def _retry_after_locked(self) -> float:
         """Hint for the 429: roughly how long until the standing queue
@@ -123,11 +149,13 @@ class AdmissionController:
         # class queued past target all window, that class has a standing
         # queue (not a burst) -> back off hard.
         worst_min = max(self._window_min.values(), default=None)
+        self._prev_limit = self.limit
         if worst_min is not None and worst_min > self.target_delay_s:
             self.limit = max(float(self.min_limit), self.limit * _BETA)
         elif worst_min is not None or self._saw_traffic:
             self.limit = min(float(self.max_limit), self.limit + 1.0)
         self._window_start = now
+        self._last_window_min = dict(self._window_min)
         self._window_min.clear()
         self._window_max_delay = 0.0
         self._saw_traffic = False

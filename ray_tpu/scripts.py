@@ -510,6 +510,39 @@ def cmd_list(args) -> None:
             [j["job_id"], j["status"], j["entrypoint"][:48]]
             for j in JobSubmissionClient().list_jobs()
         ])
+    elif kind == "replicas":
+        # Scale-plane view (serve controller): one row per replica plus a
+        # per-deployment summary line with the last autoscale decision.
+        import ray_tpu as rt
+        from ray_tpu.serve.handle import CONTROLLER_NAME, SERVE_NAMESPACE
+
+        try:
+            ctl = rt.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        except ValueError:
+            print("serve controller not running")
+            return
+        st = rt.get(ctl.get_serve_state.remote(), timeout=30)
+        rows = []
+        notes = []
+        for app, deps in sorted(st.get("apps", {}).items()):
+            for dname, d in sorted(deps.items()):
+                for rep in d["replicas"]:
+                    ongoing = rep.get("ongoing")
+                    rows.append([app, dname, rep["name"],
+                                 "-" if ongoing is None else f"{ongoing:g}",
+                                 d["target"], d["status"]])
+                if not d["replicas"]:
+                    rows.append([app, dname, "-", "-", d["target"], d["status"]])
+                last = (d.get("decisions") or [{}])[-1]
+                if last.get("action"):
+                    notes.append(
+                        f"{app}/{dname}: last decision {last['action']}"
+                        f"{'' if last.get('applied') else ' (suppressed)'} "
+                        f"-> {last.get('to')} ({last.get('reason')})"
+                        + (f"; unmet={d['unmet_replicas']}" if d.get("unmet_replicas") else "")
+                    )
+        _rows("serve replicas", ["app", "deployment", "replica", "ongoing", "target", "status"],
+              rows, note="; ".join(notes))
     elif kind == "checkpoints":
         # --fn filters by publication channel; --state by committed/aborted.
         out = state.list_checkpoints(channel=args.fn, status=args.state,
@@ -696,9 +729,10 @@ def cmd_logs(args) -> None:
 
 
 def add_state_parsers(sub) -> None:
-    lp = sub.add_parser("list", help="list tasks/actors/objects/nodes/workers/pgs/jobs/checkpoints")
+    lp = sub.add_parser("list", help="list tasks/actors/objects/nodes/workers/pgs/jobs/checkpoints/replicas")
     lp.add_argument("kind", choices=["tasks", "actors", "objects", "nodes",
-                                     "workers", "pgs", "jobs", "checkpoints"])
+                                     "workers", "pgs", "jobs", "checkpoints",
+                                     "replicas"])
     lp.add_argument("--state", default=None,
                     help="filter by FSM state (tasks: RUNNING, FINISHED, ...; "
                          "actors: ALIVE, DEAD, ...; checkpoints: committed, aborted)")

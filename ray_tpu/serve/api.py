@@ -117,6 +117,7 @@ def run(
                             "upscale_delay_s": auto.upscale_delay_s,
                             "downscale_delay_s": auto.downscale_delay_s,
                             "metrics_interval_s": auto.metrics_interval_s,
+                            "cooldown_s": auto.cooldown_s,
                         }
                         if auto
                         else None

@@ -23,12 +23,31 @@ class AutoscalingConfig:
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
     metrics_interval_s: float = 0.25
+    # Flip cooldown (scale/policy.py): after an applied change the opposite
+    # direction is suppressed for this window — a replica slow to arrive
+    # (startup compile, node provisioning) must not read as
+    # satisfied-demand and flap the target back down (chaos scenario
+    # autoscale_flap pins no-oscillation).
+    cooldown_s: float = 5.0
 
     def desired(self, total_demand: float) -> int:
         import math
 
         want = math.ceil(total_demand / max(self.target_ongoing_requests, 1e-9))
         return max(self.min_replicas, min(self.max_replicas, want))
+
+    def to_policy(self):
+        """The scale-plane decision object this config parameterizes."""
+        from ray_tpu.scale.policy import ScalePolicy
+
+        return ScalePolicy(
+            min_replicas=self.min_replicas,
+            max_replicas=self.max_replicas,
+            target_ongoing_requests=self.target_ongoing_requests,
+            upscale_delay_s=self.upscale_delay_s,
+            downscale_delay_s=self.downscale_delay_s,
+            cooldown_s=self.cooldown_s,
+        )
 
 
 @dataclasses.dataclass

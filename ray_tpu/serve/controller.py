@@ -11,6 +11,18 @@ Redesign notes: one reconcile thread replaces the reference's asyncio
 control-loop tasks; state checkpoints go to the cluster controller's KV
 (equivalent of the GCS internal KV). Replicas are detached named actors so a
 restarted ServeController re-adopts them by name instead of restarting them.
+
+Scale plane (ray_tpu/scale/): autoscaling decisions fold the QoS admission
+controller's telemetry (per-class queue-delay minima, AIMD limit slope,
+shed/expired rates pushed by the proxy via record_qos_telemetry) with
+handle demand reports and replica queue depths (heartbeats) through a
+DemandEstimator + ScalePolicy (hysteresis + flip cooldown). When a wanted
+replica cannot be placed, its resource footprint is reported to the core
+controller's external-demand table so the NODE autoscaler launches
+capacity — the overload controller requests machines instead of only
+shedding. Decisions land in a bounded per-deployment log (get_serve_state,
+/api/serve, `raytpu list replicas`), on serve.autoscale.* gauges, and as
+scale.decision trace spans when tracing is on.
 """
 from __future__ import annotations
 
@@ -20,7 +32,11 @@ import time
 import traceback
 from typing import Any, Optional
 
+from ray_tpu import chaos as _chaos
 from ray_tpu.core import serialization
+from ray_tpu.scale.signals import DemandEstimator
+from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 SERVE_NAMESPACE = "serve"
 CONTROLLER_NAME = "__serve_controller__"
@@ -48,6 +64,13 @@ def _kv_del(key: str):
     core._run(core.controller.call("kv_del", {"ns": SERVE_NAMESPACE, "key": key}))
 
 
+def _ctl_call(method: str, payload: dict) -> dict:
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    return core._run(core.controller.call(method, payload)) or {}
+
+
 class _DeploymentState:
     """Desired + actual state for one deployment in one app."""
 
@@ -60,13 +83,29 @@ class _DeploymentState:
         self.version = 0
         self.target = spec["config"]["initial_replicas"]
         self.demand: dict[int, tuple[float, float]] = {}  # handle_id -> (demand, ts)
-        self.last_upscale_ok: Optional[float] = None
-        self.last_downscale_ok: Optional[float] = None
         self.status = "UPDATING"
+        # -- scale plane -------------------------------------------------
+        # Replica queue depths from heartbeats: name -> (ongoing, ts).
+        self.replica_depths: dict[str, tuple[float, float]] = {}
+        self.estimator = DemandEstimator()
+        self.policy = None  # built lazily from autoscaling_config
+        self.last_estimate: Optional[dict] = None
+        self.scale_log: list[dict] = []  # applied/suppressed decisions (bounded)
+        self.scale_log_dropped = 0  # counted trim: the log is bounded
+        self.unmet_reported = 0  # replicas wanted but unplaceable, as reported
+
+    MAX_SCALE_LOG = 100
 
     @property
     def name(self) -> str:
         return self.spec["name"]
+
+    def log_decision(self, rec: dict) -> None:
+        self.scale_log.append(rec)
+        if len(self.scale_log) > self.MAX_SCALE_LOG:
+            trim = len(self.scale_log) - self.MAX_SCALE_LOG
+            del self.scale_log[:trim]
+            self.scale_log_dropped += trim
 
 
 class ServeController:
@@ -78,6 +117,18 @@ class ServeController:
         self.routes: dict[str, tuple[str, str]] = {}  # prefix -> (app, deployment)
         self.http_port: Optional[int] = None
         self._stop = threading.Event()
+        # QoS telemetry pushed by proxies (scale plane): reporter -> (report, ts).
+        self.qos_reports: dict[str, tuple[dict, float]] = {}
+        # Autoscaler observability: actual + target replica counts per
+        # deployment (reporter -> controller -> /metrics).
+        self._replicas_gauge = _metrics.Gauge(
+            "serve.autoscale.replicas",
+            "live replicas per deployment (scale plane actual)",
+            tag_keys=("app", "deployment"))
+        self._target_gauge = _metrics.Gauge(
+            "serve.autoscale.target",
+            "desired replicas per deployment (scale plane target)",
+            tag_keys=("app", "deployment"))
         # Notified after every state-changing reconcile pass: server-side
         # blocking waits (wait_app_healthy) ride this instead of clients
         # polling get_status (reference: LongPollHost).
@@ -107,6 +158,11 @@ class ServeController:
                         st.replica_rev = prev.replica_rev
                         st.spec_rev = prev.spec_rev + 1
                         st.version = prev.version + 1
+                        # Carry the external-demand bookkeeping: the fresh
+                        # state's 0 would otherwise match a now-satisfiable
+                        # `missing == 0` and the stale table entry would
+                        # leak node-autoscaler demand forever.
+                        st.unmet_reported = prev.unmet_reported
                         if prev.spec["config"] == spec["config"]:
                             st.target = prev.target
                     new[spec["name"]] = st
@@ -118,6 +174,8 @@ class ServeController:
                 self.routes[route_prefix] = (app_name, ingress)
         for dep in removed:
             self._stop_all_replicas(dep)
+            if dep.unmet_reported:
+                self._report_unmet(dep, 0)  # release node-autoscaler demand
         self._checkpoint()
 
     def delete_app(self, app_name: str):
@@ -126,6 +184,8 @@ class ServeController:
             self.routes = {p: t for p, t in self.routes.items() if t[0] != app_name}
         for dep in deps:
             self._stop_all_replicas(dep)
+            if dep.unmet_reported:
+                self._report_unmet(dep, 0)  # release node-autoscaler demand
         self._checkpoint()
 
     def shutdown(self):
@@ -167,6 +227,57 @@ class ServeController:
             dep = self.apps.get(app, {}).get(deployment)
             if dep is not None:
                 dep.demand[handle_id] = (demand, ts)
+
+    def record_qos_telemetry(self, reporter: str, report: dict, ts: float):
+        """Proxy push (scale plane): the AIMD controller's telemetry plus
+        per-deployment shed/expired/request tallies. Folded into each
+        autoscaling deployment's demand estimate next control-loop tick."""
+        with self.lock:
+            self.qos_reports[reporter] = (report, ts)
+            # Expired reporters (dead proxies) age out; the table stays
+            # bounded by the live proxy count.
+            cutoff = time.time() - 60.0
+            for gone in [r for r, (_, t) in self.qos_reports.items() if t < cutoff]:
+                del self.qos_reports[gone]
+
+    def get_serve_state(self) -> dict:
+        """The scale-plane view: per-deployment targets, live replicas with
+        their heartbeat queue depths, the last demand estimate, and the
+        bounded autoscale decision log. Serves /api/serve and
+        `raytpu list replicas`."""
+        now = time.time()
+        with self.lock:
+            return {
+                "http_port": self.http_port,
+                "apps": {
+                    a: {
+                        d.name: {
+                            "status": d.status,
+                            "target": d.target,
+                            "autoscaling": bool(
+                                d.spec["config"].get("autoscaling_config")),
+                            "replicas": [
+                                {
+                                    "name": n,
+                                    "rev": d.replica_rev.get(n, -1),
+                                    "ongoing": d.replica_depths.get(n, (None, 0))[0],
+                                }
+                                for n in d.replicas
+                            ],
+                            "demand": sum(
+                                dm for dm, ts in d.demand.values()
+                                if now - ts < 5.0
+                            ),
+                            "unmet_replicas": d.unmet_reported,
+                            "last_estimate": d.last_estimate,
+                            "decisions": list(d.scale_log[-20:]),
+                            "decisions_dropped": d.scale_log_dropped,
+                        }
+                        for d in deps.values()
+                    }
+                    for a, deps in self.apps.items()
+                },
+            }
 
     def get_status(self) -> dict:
         with self.lock:
@@ -248,6 +359,14 @@ class ServeController:
                 fresh.append(name)
             else:
                 break  # no capacity now; retry next tick
+        # Scale plane: replicas we want but could not start are PENDING
+        # DEMAND for the node autoscaler. Report the unmet footprint to the
+        # core controller's external-demand table (and clear it once
+        # satisfied) so "the cluster is full" turns into "launch a node"
+        # instead of a wedged UPDATING deployment.
+        missing = max(0, want - len(fresh))
+        if missing != dep.unmet_reported:
+            self._report_unmet(dep, missing)
         if len(fresh) >= want and stale:
             # Enough current-code capacity: retire old code.
             for name in stale:
@@ -273,11 +392,73 @@ class ServeController:
             ) else "UPDATING"
         return changed
 
+    def _replica_footprint(self, dep: _DeploymentState) -> dict:
+        """One replica's resource demand (the node-autoscaler shape: CPU/TPU
+        + custom resources), derived from the deployment's actor options."""
+        aopts = dict(dep.spec["config"].get("ray_actor_options") or {})
+        demand = dict(aopts.get("resources") or {})
+        num_cpus = float(aopts.get("num_cpus", 0.0))
+        if num_cpus:
+            demand["CPU"] = demand.get("CPU", 0.0) + num_cpus
+        return demand
+
+    def _fits_somewhere(self, demand: dict) -> bool:
+        """Does any ALIVE node currently have room for this footprint?"""
+        from ray_tpu.core.controller import _fits
+
+        try:
+            state = _ctl_call("get_cluster_state", {})
+        except Exception:
+            return True  # cannot tell: attempt the start and let it decide
+        return any(
+            n.get("state") == "ALIVE"
+            and _fits(n.get("resources_available", {}), demand)
+            for n in state.get("nodes", {}).values()
+        )
+
+    def _report_unmet(self, dep: _DeploymentState, missing: int) -> None:
+        """Sync the deployment's unplaceable-replica demand with the core
+        controller's external-demand table (missing == 0 clears it)."""
+        source = f"serve:{dep.app}/{dep.name}"
+        footprint = self._replica_footprint(dep)
+        items = [{"demand": footprint, "label_selector": {}}] * missing if footprint else []
+        # A zero-footprint replica fits any node, so nothing is registered
+        # for it — but unmet_reported still records `missing` so the
+        # reconcile tick does not re-call this RPC 10x/sec for the whole
+        # failure. The RPC only runs when there is something to register or
+        # a previous registration to clear.
+        if footprint or dep.unmet_reported:
+            try:
+                _ctl_call("set_external_demand", {"source": source, "items": items})
+            except Exception:
+                return  # core controller hiccup: retry next reconcile
+        with self.lock:
+            dep.unmet_reported = missing
+
     def _start_replica(self, dep: _DeploymentState) -> Optional[str]:
         """Start one replica from the CURRENT spec; returns its name."""
         import ray_tpu as rt
         from ray_tpu.serve.replica import Replica
 
+        # Chaos site scale.replica.start: delayed or failed replica startup
+        # (slow node provisioning, image pulls, a flaky first health check).
+        # The autoscale_flap scenario pins that a slow-to-arrive replica
+        # does not make the scale policy oscillate.
+        fault = _chaos.maybe_inject("scale.replica.start",
+                                    deployment=dep.name, app=dep.app)
+        if fault is not None:
+            if fault.kind == "delay":
+                time.sleep(fault.delay_s)
+            elif fault.kind == "error":
+                return None  # start fails this tick; reconcile retries
+        # Fast feasibility gate: a footprint no live node can host right now
+        # would wedge this loop for the whole startup timeout. Fail the
+        # start immediately instead — _reconcile reports the unmet
+        # footprint to the node autoscaler's external-demand table, and the
+        # start retries next tick (by which time a node may have launched).
+        footprint = self._replica_footprint(dep)
+        if footprint and not self._fits_somewhere(footprint):
+            return None
         callable_, args, kwargs, user_config = serialization.deserialize(dep.spec["blob"])
         rid = f"{dep.name}#{random.randrange(16**6):06x}"
         actor_name = f"{dep.app}:{rid}"
@@ -318,6 +499,7 @@ class ServeController:
         with self.lock:
             handle = dep.replicas.pop(name, None)
             dep.replica_rev.pop(name, None)
+            dep.replica_depths.pop(name, None)
             dep.version += 1
         if handle is None:
             return
@@ -342,9 +524,15 @@ class ServeController:
         with self.lock:
             items = list(dep.replicas.items())
         dead = []
+        now = time.time()
         for name, handle in items:
             try:
-                ok = rt.get(handle.check_health.remote(), timeout=10)
+                # heartbeat = health + queue depth in one round trip; the
+                # depth feeds the scale plane's server-side demand view.
+                hb = rt.get(handle.heartbeat.remote(), timeout=10)
+                ok = bool(hb.get("healthy"))
+                with self.lock:
+                    dep.replica_depths[name] = (float(hb.get("ongoing", 0)), now)
             except Exception:
                 ok = False
             if not ok:
@@ -353,6 +541,7 @@ class ServeController:
             with self.lock:
                 dep.replicas.pop(name, None)
                 dep.replica_rev.pop(name, None)
+                dep.replica_depths.pop(name, None)
                 dep.version += 1
             # Best-effort kill in case it's alive-but-unhealthy.
             try:
@@ -364,6 +553,11 @@ class ServeController:
     def _autoscale(self, dep: _DeploymentState) -> bool:
         cfg = dep.spec["config"]
         auto = cfg.get("autoscaling_config")
+        with self.lock:
+            # Observability regardless of autoscaling: actual + target.
+            tags = {"app": dep.app, "deployment": dep.name}
+            self._replicas_gauge.set(len(dep.replicas), tags=tags)
+            self._target_gauge.set(dep.target, tags=tags)
         if not auto:
             return False
         from ray_tpu.serve.config import AutoscalingConfig
@@ -371,30 +565,60 @@ class ServeController:
         ac = AutoscalingConfig(**auto)
         now = time.time()
         with self.lock:
+            if dep.policy is None:
+                dep.policy = ac.to_policy()
             # Demand = most recent handle reports (stale ones expire).
-            dep.demand = {h: (d, ts) for h, (d, ts) in dep.demand.items() if now - ts < 5 * ac.metrics_interval_s + 1.0}
-            total = sum(d for d, _ in dep.demand.values())
-            desired = ac.desired(total)
-            cur = dep.target
-            if desired > cur:
-                dep.last_downscale_ok = None
-                if dep.last_upscale_ok is None:
-                    dep.last_upscale_ok = now
-                if now - dep.last_upscale_ok >= ac.upscale_delay_s:
-                    dep.target = desired
-                    dep.last_upscale_ok = None
-                    return True
-            elif desired < cur:
-                dep.last_upscale_ok = None
-                if dep.last_downscale_ok is None:
-                    dep.last_downscale_ok = now
-                if now - dep.last_downscale_ok >= ac.downscale_delay_s:
-                    dep.target = desired
-                    dep.last_downscale_ok = None
-                    return True
-            else:
-                dep.last_upscale_ok = dep.last_downscale_ok = None
-        return False
+            dep.demand = {h: (d, ts) for h, (d, ts) in dep.demand.items()
+                          if now - ts < 5 * ac.metrics_interval_s + 1.0}
+            # QoS reports that mention THIS deployment: the global AIMD
+            # signals (delay minima, limit slope) attributed alongside the
+            # deployment's own shed/expired tallies.
+            dkey = f"{dep.app}/{dep.name}"
+            qos_reports = []
+            for reporter, (report, ts) in self.qos_reports.items():
+                dstats = report.get("deployments", {}).get(dkey)
+                if dstats is None:
+                    continue  # this proxy never routed the deployment
+                qos_reports.append((reporter, {
+                    "delay_min_by_class": report.get("delay_min_by_class", {}),
+                    "target_delay_s": report.get("target_delay_s", 0.0),
+                    "limit_trend": report.get("limit_trend", 0.0),
+                    "sheds_total": dstats.get("sheds_total", 0.0),
+                    "expired_total": dstats.get("expired_total", 0.0),
+                    "requests_total": dstats.get("requests_total", 0.0),
+                }, ts))
+            est = dep.estimator.fold(
+                handle_demand=list(dep.demand.values()),
+                replica_depths=list(dep.replica_depths.values()),
+                qos_reports=qos_reports,
+                now=now,
+            )
+            decision = dep.policy.decide(est, dep.target, now=now)
+            dep.last_estimate = est.to_dict()
+            if decision.applied or decision.reason == "cooldown":
+                # Applied changes AND cooldown suppressions are logged — a
+                # suppressed flip is exactly what the operator debugging an
+                # oscillation needs to see.
+                dep.log_decision({
+                    "ts": decision.ts, "action": decision.action,
+                    "applied": decision.applied, "from": dep.target,
+                    "to": decision.target, "desired": decision.desired,
+                    "reason": decision.reason,
+                    "signals": decision.signals,
+                })
+            if not decision.applied:
+                return False
+            old = dep.target
+            dep.target = decision.target
+        if _tracing.trace_enabled():
+            # A point trace per applied decision: the scale plane's actions
+            # interleave with request spans on /api/traces.
+            with _tracing.span("scale.decision", app=dep.app,
+                               deployment=dep.name, action=decision.action,
+                               reason=decision.reason, from_replicas=old,
+                               to_replicas=decision.target):
+                pass
+        return True
 
     # -- checkpoint / restore ---------------------------------------------
     def _checkpoint(self):

@@ -17,6 +17,12 @@ one with fewer ongoing requests). Departures, by design:
   a replica slot.
 - Demand metrics (queued + ongoing) are pushed to the ServeController for
   autoscaling (reference: autoscaling_state.py handle metrics).
+- KV-cache-aware routing (scale/router.py): requests carrying a prompt-
+  prefix digest, a multiplexed model id, or an explicit affinity key stick
+  to the replica that last served that key (ONE counted-eviction
+  AffinityMap for all three kinds), falling back to power-of-two-choices
+  on queue depth. Per-pick accounting on
+  serve.routing.cache_hit_total{kind=prefix|affinity|p2c}.
 """
 from __future__ import annotations
 
@@ -28,6 +34,7 @@ from typing import Any, Optional
 
 from ray_tpu.qos import context as _qos
 from ray_tpu.qos.fair_queue import FairWaitQueue, Waiter
+from ray_tpu.scale.router import AffinityMap
 
 SERVE_NAMESPACE = "serve"
 CONTROLLER_NAME = "__serve_controller__"
@@ -64,7 +71,10 @@ class _ReplicaSet:
     """Shared per-process routing state for one deployment."""
 
     REFRESH_S = 1.0
-    AFFINITY_CAP = 1024  # bound on sticky model->replica pins (LRU evicted)
+    # Per-KIND bound on sticky key->replica pins (model ids, affinity keys,
+    # prompt prefixes share ONE AffinityMap but evict within their own kind
+    # — prefix churn cannot thrash model pins; LRU evicted, counted).
+    AFFINITY_CAP = 1024
 
     def __init__(self, app_name: str, deployment_name: str):
         self.app = app_name
@@ -79,11 +89,6 @@ class _ReplicaSet:
         self.version = -1
         self.fetched_at = 0.0
         self.queued = 0
-        # Sticky affinity: key -> replica that last served it. Keys are
-        # multiplexed model ids (the replica that loaded the model) or
-        # router/affinity keys (prefix routing: the replica whose engine
-        # caches those KV pages).
-        self.model_affinity: dict[str, str] = {}
         # Optional deployment-provided request-router policy fn(Request)->key,
         # executed by the proxy (reference: PrefixCacheAffinityRouter).
         self.request_router = None
@@ -104,13 +109,25 @@ class _ReplicaSet:
         self._ongoing_gauge = _metrics.Gauge(
             "serve.handle.ongoing", "requests in flight to replicas from this handle",
             tag_keys=("app", "deployment")).set_default_tags(tags)
-        # No silent caps (graftlint counted-trims): an LRU-evicted affinity
-        # pin costs a model reload on the next request for that key, so the
-        # eviction rate is an operator signal, not an internal detail.
+        # ONE sticky-pin structure for every affinity kind — multiplexed
+        # model ids ("m:"), explicit affinity keys ("k:"), prompt-prefix
+        # digests ("p:") — replacing the old model-affinity dict + a
+        # would-be second prefix cache. No silent caps (graftlint
+        # counted-trims): an LRU-evicted pin costs a model reload or a cold
+        # prefill on the next request for that key, so the eviction rate is
+        # an operator signal, not an internal detail.
         self._affinity_evicted = _metrics.Counter(
-            "serve.handle.affinity_evicted",
-            "sticky model->replica pins dropped by the AFFINITY_CAP LRU bound",
+            "serve.routing.affinity_evicted",
+            "sticky key->replica pins dropped by the AFFINITY_CAP LRU bound",
             tag_keys=("app", "deployment")).set_default_tags(tags)
+        self.affinity = AffinityMap(cap=self.AFFINITY_CAP,
+                                    on_evict=self._affinity_evicted.inc)
+        # Per-pick routing accounting: which mechanism chose the replica
+        # (warm-cache hit kinds vs the power-of-two-choices fallback).
+        self._cache_hit = _metrics.Counter(
+            "serve.routing.cache_hit_total",
+            "routing decisions by mechanism (prefix/affinity pin hit vs p2c fallback)",
+            tag_keys=("kind", "app", "deployment")).set_default_tags(tags)
         # QoS admission queue (strict class priority / DRR tenants / FIFO)
         # + the queue-delay histogram the proxy's AIMD controller and the
         # dashboards read. All fair-queue state is guarded by self.cond.
@@ -179,12 +196,10 @@ class _ReplicaSet:
                         self.request_router = None
                 else:
                     self.request_router = None
-                # Drop affinity pins to replicas that left the membership —
-                # stale names are skipped by _pick_locked but would otherwise
-                # sit in the dict forever.
-                self.model_affinity = {
-                    m: r for m, r in self.model_affinity.items() if r in handles
-                }
+                # Release affinity pins to replicas that left the membership
+                # — a dead replica's warm cache is gone with it, so requests
+                # pinned there must re-route (and re-pin) via p2c.
+                self.affinity.retain(handles)
                 # Keep counts for surviving replicas; drop departed ones.
                 self.ongoing = {n: self.ongoing.get(n, 0) for n in handles}
                 self._grant_locked()  # fresh capacity: hand out slots in policy order
@@ -225,7 +240,8 @@ class _ReplicaSet:
             w.admitted = (name, self.replicas[name])
             w.event.set()
 
-    def _admit(self, timeout_s: float, model_id: str = "", affinity_key: str = ""):
+    def _admit(self, timeout_s: float, model_id: str = "", affinity_key: str = "",
+               prefix_key: str = ""):
         """Block until this request is granted a replica slot by the fair
         queue; returns (name, handle) with the ongoing count already
         incremented. QoS: the active RequestContext supplies the priority
@@ -242,7 +258,7 @@ class _ReplicaSet:
         w = Waiter(
             rank=ctx.rank if ctx is not None else 0,
             tenant=ctx.tenant if ctx is not None else _qos.DEFAULT_TENANT,
-            affinity=model_id or affinity_key,
+            affinity=self._routing_keys(model_id, affinity_key, prefix_key),
             deadline=deadline_eff,
             enqueued_at=now,
         )
@@ -315,10 +331,13 @@ class _ReplicaSet:
         return _qos.to_wire(_dc_replace(base, rid=rid))
 
     def route(self, method: str, args: tuple, kwargs: dict, timeout_s: float = 60.0,
-              model_id: str = "", affinity_key: str = "", rid: str = ""):
-        """Pick a replica (pow-2 choices; sticky when a multiplexed model id
-        or an affinity key is set), submit, return (ref, name)."""
-        name, replica = self._admit(timeout_s, model_id=model_id, affinity_key=affinity_key)
+              model_id: str = "", affinity_key: str = "", prefix_key: str = "",
+              rid: str = ""):
+        """Pick a replica (pow-2 choices; sticky when a multiplexed model id,
+        an affinity key, or a prompt-prefix key is set), submit, return
+        (ref, name)."""
+        name, replica = self._admit(timeout_s, model_id=model_id,
+                                    affinity_key=affinity_key, prefix_key=prefix_key)
         token = _qos.activate(self._submission_ctx(rid))
         try:
             if model_id:
@@ -340,11 +359,13 @@ class _ReplicaSet:
 
     def route_streaming(self, method: str, args: tuple, kwargs: dict,
                         timeout_s: float = 60.0, proxy: bool = False,
-                        model_id: str = "", affinity_key: str = "", rid: str = ""):
+                        model_id: str = "", affinity_key: str = "",
+                        prefix_key: str = "", rid: str = ""):
         """Streaming variant: returns (ObjectRefGenerator, name). The ongoing
         count is held until the caller exhausts/closes the stream and calls
         _release(name) (DeploymentResponseGenerator owns that)."""
-        name, replica = self._admit(timeout_s, model_id=model_id, affinity_key=affinity_key)
+        name, replica = self._admit(timeout_s, model_id=model_id,
+                                    affinity_key=affinity_key, prefix_key=prefix_key)
         actor_method = (
             replica.handle_request_proxy if proxy else replica.handle_request_streaming
         )
@@ -389,31 +410,50 @@ class _ReplicaSet:
         finally:
             _qos.deactivate(token)
 
-    def _pick_locked(self, affinity: str = "") -> Optional[str]:
+    @staticmethod
+    def _routing_keys(model_id: str = "", affinity_key: str = "",
+                      prefix_key: str = "") -> tuple:
+        """Ordered sticky-key candidates for one request. Routing order is
+        prefix -> affinity (model pins and explicit keys share the kind) ->
+        p2c fallback; the namespacing prefixes keep the three key spaces
+        collision-free inside the ONE AffinityMap."""
+        keys = []
+        if prefix_key:
+            keys.append(("prefix", "p:" + prefix_key))
+        if model_id:
+            keys.append(("affinity", "m:" + model_id))
+        if affinity_key:
+            keys.append(("affinity", "k:" + affinity_key))
+        return tuple(keys)
+
+    def _pick_locked(self, keys: tuple = ()) -> Optional[str]:
         live = [n for n in self.replicas if self.ongoing.get(n, 0) < self.max_ongoing]
         if not live:
             return None
-        if affinity:
-            # Model affinity (reference: multiplex-aware router): the replica
-            # that last served this model already holds it loaded — reuse it
-            # while it has capacity; otherwise fall through to pow-2 and
-            # re-pin the affinity to the new pick.
-            sticky = self.model_affinity.get(affinity)
+        # Warm-cache stickiness, most specific first: the replica pinned to
+        # the request's prompt-prefix digest holds those KV pages hot; the
+        # model/affinity pin holds the model loaded. Reuse while it has
+        # capacity; otherwise fall through to pow-2 and re-pin every key to
+        # the new pick (the new replica is now the warm one).
+        for kind, key in keys:
+            sticky = self.affinity.get(key)
             if sticky in live:
-                self.model_affinity.pop(affinity)  # LRU: move to newest
-                self.model_affinity[affinity] = sticky
+                self._cache_hit.inc(tags={"kind": kind})
+                # The serving replica is now the warm one for EVERY key the
+                # request carries (a prefix pin whose replica saturated
+                # must follow the request to where it actually ran).
+                for _okind, okey in keys:
+                    if okey != key:
+                        self.affinity.pin(okey, sticky)
                 return sticky
         if len(live) == 1:
             pick = live[0]
         else:
             a, b = random.sample(live, 2)
             pick = a if self.ongoing.get(a, 0) <= self.ongoing.get(b, 0) else b
-        if affinity:
-            self.model_affinity.pop(affinity, None)
-            self.model_affinity[affinity] = pick
-            while len(self.model_affinity) > self.AFFINITY_CAP:  # LRU bound
-                self.model_affinity.pop(next(iter(self.model_affinity)))
-                self._affinity_evicted.inc()
+        for _kind, key in keys:
+            self.affinity.pin(key, pick)
+        self._cache_hit.inc(tags={"kind": "p2c"})
         return pick
 
     def fail_over(self, name: str):
@@ -516,17 +556,19 @@ class DeploymentResponse:
     generate loop) stops burning capacity for a departed caller."""
 
     def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict,
-                 model_id: str = "", affinity_key: str = ""):
+                 model_id: str = "", affinity_key: str = "", prefix_key: str = ""):
         self._rs = rs
         self._method = method
         self._args = args
         self._kwargs = kwargs
         self._model_id = model_id
         self._affinity_key = affinity_key
+        self._prefix_key = prefix_key
         self._rid = _qos.mint_rid()
         self._cancelled = False
         self._ref, self._idx = rs.route(method, args, kwargs, model_id=model_id,
-                                        affinity_key=affinity_key, rid=self._rid)
+                                        affinity_key=affinity_key,
+                                        prefix_key=prefix_key, rid=self._rid)
 
     def result(self, timeout: float | None = 60.0):
         import ray_tpu as rt
@@ -551,7 +593,8 @@ class DeploymentResponse:
                     raise
                 self._ref, self._idx = self._rs.route(
                     self._method, self._args, self._kwargs, model_id=self._model_id,
-                    affinity_key=self._affinity_key, rid=self._rid,
+                    affinity_key=self._affinity_key, prefix_key=self._prefix_key,
+                    rid=self._rid,
                 )
 
     def cancel(self):
@@ -583,13 +626,14 @@ class DeploymentResponseGenerator:
     is exhausted, errors, or is closed."""
 
     def __init__(self, rs: _ReplicaSet, method: str, args: tuple, kwargs: dict,
-                 proxy: bool = False, model_id: str = "", affinity_key: str = ""):
+                 proxy: bool = False, model_id: str = "", affinity_key: str = "",
+                 prefix_key: str = ""):
         self._rs = rs
         self._released = False
         self._rid = _qos.mint_rid()
         self._gen, self._name = rs.route_streaming(
             method, args, kwargs, proxy=proxy, model_id=model_id,
-            affinity_key=affinity_key, rid=self._rid,
+            affinity_key=affinity_key, prefix_key=prefix_key, rid=self._rid,
         )
 
     def __iter__(self):
@@ -691,17 +735,20 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__", stream: bool = False,
-                 multiplexed_model_id: str = "", affinity_key: str = ""):
+                 multiplexed_model_id: str = "", affinity_key: str = "",
+                 prefix_key: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
         self.stream = stream
         self.multiplexed_model_id = multiplexed_model_id
         self.affinity_key = affinity_key
+        self.prefix_key = prefix_key
 
     def options(self, method_name: Optional[str] = None, stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
-                affinity_key: Optional[str] = None) -> "DeploymentHandle":
+                affinity_key: Optional[str] = None,
+                prefix_key: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name,
             self.app_name,
@@ -709,6 +756,7 @@ class DeploymentHandle:
             self.stream if stream is None else stream,
             self.multiplexed_model_id if multiplexed_model_id is None else multiplexed_model_id,
             self.affinity_key if affinity_key is None else affinity_key,
+            self.prefix_key if prefix_key is None else prefix_key,
         )
 
     def __getattr__(self, name: str):
@@ -716,22 +764,25 @@ class DeploymentHandle:
             raise AttributeError(name)
         return DeploymentHandle(self.deployment_name, self.app_name, name,
                                 self.stream, self.multiplexed_model_id,
-                                self.affinity_key)
+                                self.affinity_key, self.prefix_key)
 
     def remote(self, *args, **kwargs):
         rs = _replica_set(self.app_name, self.deployment_name)
         if self.stream:
             return DeploymentResponseGenerator(rs, self.method_name, args, kwargs,
                                                model_id=self.multiplexed_model_id,
-                                               affinity_key=self.affinity_key)
+                                               affinity_key=self.affinity_key,
+                                               prefix_key=self.prefix_key)
         return DeploymentResponse(rs, self.method_name, args, kwargs,
                                   model_id=self.multiplexed_model_id,
-                                  affinity_key=self.affinity_key)
+                                  affinity_key=self.affinity_key,
+                                  prefix_key=self.prefix_key)
 
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name, self.app_name,
                                    self.method_name, self.stream,
-                                   self.multiplexed_model_id, self.affinity_key))
+                                   self.multiplexed_model_id, self.affinity_key,
+                                   self.prefix_key))
 
     def __repr__(self):
         return f"DeploymentHandle({self.app_name}/{self.deployment_name}.{self.method_name})"

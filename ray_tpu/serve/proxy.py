@@ -21,6 +21,7 @@ from typing import Any, Optional
 from urllib.parse import parse_qs, urlsplit
 
 from ray_tpu.qos import context as _qos
+from ray_tpu.scale import router as _scale_router
 
 
 def _capped_timeout(timeout_s, default: float = 60.0) -> float:
@@ -205,6 +206,17 @@ class ProxyActor:
                 on_adapt=_on_adapt,
             )
             self._limit_gauge.set(self._qos_ctl.limit)
+        # -- scale plane: per-deployment shed/expired tallies + the QoS
+        # telemetry pusher (proxy -> ServeController -> scale/signals.py).
+        # The AIMD controller's own signals can only SHED here; shipped to
+        # the controller they let the autoscaler REQUEST capacity.
+        self._dep_qos_lock = threading.Lock()
+        self._dep_qos: dict[str, dict] = {}  # "app/dep" -> cumulative tallies
+        self._qos_pusher: Optional[threading.Thread] = None
+        if self._qos_ctl is not None:
+            self._qos_pusher = threading.Thread(
+                target=self._qos_push_loop, name="proxy-qos-push", daemon=True)
+            self._qos_pusher.start()
         self._loop = asyncio.new_event_loop()
         self._ready = threading.Event()
         self._thread = threading.Thread(target=self._serve, name="serve-proxy", daemon=True)
@@ -531,11 +543,52 @@ class ProxyActor:
                           path=urlsplit(target).path or "/"):
             return self._dispatch_inner(method, target, headers, body)
 
-    def _shed_response(self, klass: str, retry_after: float):
+    def _note_dep_qos(self, app: str, deployment: str, field: str):
+        """Cumulative per-deployment tallies the telemetry pusher ships to
+        the serve controller (the estimator differentiates them into
+        shed/expiry rates per deployment)."""
+        key = f"{app}/{deployment}"
+        with self._dep_qos_lock:
+            rec = self._dep_qos.setdefault(
+                key, {"sheds_total": 0.0, "expired_total": 0.0, "requests_total": 0.0})
+            rec[field] += 1.0
+
+    def _qos_push_loop(self):
+        """Ship the AIMD controller's telemetry + per-deployment tallies to
+        the ServeController every half second (fire-and-forget, like the
+        handle's demand pusher). The push is the upscale half of the QoS
+        loop: these same signals already shed load locally."""
+        from ray_tpu.serve.handle import _controller
+
+        reporter = f"proxy-{id(self)}"
+        last_empty = False
+        while True:
+            time.sleep(0.5)
+            ctl = self._qos_ctl
+            if ctl is None:
+                return
+            report = ctl.telemetry()
+            with self._dep_qos_lock:
+                report["deployments"] = {k: dict(v) for k, v in self._dep_qos.items()}
+            if not report["deployments"]:
+                if last_empty:
+                    continue  # nothing routed yet / idle: don't spam the controller
+                last_empty = True
+            else:
+                last_empty = False
+            try:
+                _controller().record_qos_telemetry.remote(reporter, report, time.time())
+            except Exception:
+                pass  # controller restarting: next tick retries
+
+    def _shed_response(self, klass: str, retry_after: float,
+                       app: str = "", deployment: str = ""):
         """Reject one request under overload: 429 + Retry-After, counted
         (serve.request.shed_total{reason,class}) and dropped onto the active
         trace — never a silent rejection (graftlint: counted-sheds)."""
         self._shed_total.inc(tags={"reason": "overload", "class": klass})
+        if deployment:
+            self._note_dep_qos(app, deployment, "sheds_total")
         from ray_tpu.util import tracing as _tracing
 
         _tracing.event("qos.shed", reason="overload", cls=klass)
@@ -595,7 +648,7 @@ class ProxyActor:
             if self._qos_ctl is not None:
                 ok, retry_after = self._qos_ctl.try_admit(rank)
                 if not ok:
-                    return self._shed_response(klass, retry_after)
+                    return self._shed_response(klass, retry_after, app, deployment)
                 admitted = True
             try:
                 from ray_tpu.core.worker import ActorDiedError
@@ -618,13 +671,23 @@ class ProxyActor:
                         akey = str(router_fn(req) or akey)
                     except Exception:
                         traceback.print_exc()
+                # KV-cache-aware routing: a digest of the prompt head
+                # (tenant-scoped) pins same-prefix requests to the replica
+                # whose engine prefix-cache holds those KV pages. Clients
+                # may also pass x-prefix-key directly.
+                pkey = headers.get("x-prefix-key", "")
+                if not pkey:
+                    pkey = _scale_router.prefix_key_for_body(
+                        body, qwire[1] if qwire is not None else "")
+                self._note_dep_qos(app, deployment, "requests_total")
                 # Retry replica death only before the first item: nothing has
                 # reached the client yet, so re-routing is safe (mid-stream death
                 # is surfaced — items were already delivered).
                 for attempt in range(3):
                     t_admit = time.perf_counter()
                     gen = DeploymentResponseGenerator(rs, "__call__", (req,), {},
-                                                      proxy=True, affinity_key=akey)
+                                                      proxy=True, affinity_key=akey,
+                                                      prefix_key=pkey)
                     if self._qos_ctl is not None:
                         # The AIMD signal: time spent waiting for a replica
                         # slot in the handle's fair queue (pure queueing —
@@ -644,7 +707,9 @@ class ProxyActor:
                             raise
             except _qos.DeadlineExceeded as e:
                 # Counted at the hop that dropped it (expired_total{hop});
-                # the client sees a typed timeout status, not a 500.
+                # the client sees a typed timeout status, not a 500. The
+                # per-deployment tally feeds the scale plane's expiry rate.
+                self._note_dep_qos(app, deployment, "expired_total")
                 return ("504 Gateway Timeout",
                         json.dumps({"error": str(e)}).encode(), "application/json")
             except Exception as e:

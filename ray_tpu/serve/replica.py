@@ -253,6 +253,14 @@ class Replica:
         if not self._is_function and hasattr(self._instance, "reconfigure"):
             self._instance.reconfigure(user_config)
 
+    def heartbeat(self) -> dict:
+        """Health + queue depth in one round trip (controller health loop).
+        The ongoing count is the scale plane's server-side demand signal —
+        it survives a handle process dying with its demand reports."""
+        with self._lock:
+            ongoing, total = self._ongoing, self._total
+        return {"healthy": self.check_health(), "ongoing": ongoing, "total": total}
+
     def check_health(self) -> bool:
         if not self._is_function and hasattr(self._instance, "check_health"):
             try:

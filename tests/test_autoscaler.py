@@ -3,6 +3,8 @@
 module: needs its own cluster session with infeasible_as_pending set."""
 import time
 
+import pytest
+
 import ray_tpu as rt
 
 
@@ -43,6 +45,107 @@ def test_autoscaler_scales_up_and_down():
         result = autoscaler.update()  # still idle: terminates
         assert result["terminated"], result
     finally:
+        shutdown()
+        cluster.shutdown()
+
+
+def test_external_demand_drives_node_launch_and_clears():
+    """Scale plane hand-off: demand registered through the core
+    controller's external-demand table (the serve controller's
+    unplaceable-replica path) makes the NODE autoscaler launch capacity;
+    clearing the source stops holding nodes up."""
+    from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider, NodeType
+    from ray_tpu.core import api
+    from ray_tpu.core.api import Cluster, init, shutdown
+
+    cluster = Cluster(initialize_head=False)
+    cluster.add_node(num_cpus=1)
+    init(address=cluster.address)
+    try:
+        core = api._require_worker()
+
+        def ctl(method, payload):
+            return core._run(core.controller.call(method, payload))
+
+        provider = LocalNodeProvider(cluster)
+        autoscaler = Autoscaler(
+            [NodeType("cpu4", {"CPU": 4.0}, max_workers=3)], provider,
+            idle_timeout_s=3600.0)
+        # No external demand: nothing to launch.
+        assert autoscaler.update()["launched"] == {}
+        # Two unplaceable 3-CPU replicas -> two cpu4 nodes.
+        out = ctl("set_external_demand", {
+            "source": "serve:app/dep",
+            "items": [{"demand": {"CPU": 3.0}}, {"demand": {"CPU": 3.0}}],
+        })
+        assert out["ok"]
+        state = ctl("get_autoscaler_state", {})
+        assert sum(1 for p in state["pending"] if p.get("kind") == "external") == 2
+        result = autoscaler.update()
+        assert result["launched"].get("cpu4") == 2, result
+        # Satisfied: the source clears and pending demand drops to zero.
+        assert ctl("set_external_demand", {"source": "serve:app/dep", "items": []})["ok"]
+        state = ctl("get_autoscaler_state", {})
+        assert not any(p.get("kind") == "external" for p in state["pending"])
+        assert autoscaler.update()["launched"] == {}
+    finally:
+        shutdown()
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_serve_unplaceable_replica_requests_node_capacity():
+    """E2E scale-plane hand-off: a serve replica whose footprint fits NO
+    live node makes the serve controller register external demand, the
+    node autoscaler launches a matching node, and the deployment then
+    converges HEALTHY on the new capacity."""
+    import threading
+
+    from ray_tpu import serve
+    from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider, NodeType
+    from ray_tpu.core.api import Cluster, init, shutdown
+
+    cluster = Cluster(initialize_head=False)
+    cluster.add_node(num_cpus=4)  # no SRV resource anywhere
+    init(address=cluster.address)
+    try:
+        @serve.deployment(name="Pinned",
+                          ray_actor_options={"resources": {"SRV": 1.0}})
+        class Pinned:
+            def __call__(self, x="-"):
+                return "ok"
+
+        serve.start(proxy=False)
+        err: list = []
+
+        def deploy():
+            try:
+                serve.run(Pinned.bind(), name="pinned", http=False, timeout_s=120)
+            except Exception as e:  # noqa: BLE001 — surfaced by the assert below
+                err.append(e)
+
+        th = threading.Thread(target=deploy, daemon=True)
+        th.start()
+        provider = LocalNodeProvider(cluster)
+        autoscaler = Autoscaler(
+            [NodeType("srv", {"CPU": 2.0, "SRV": 4.0}, max_workers=2)],
+            provider, idle_timeout_s=3600.0)
+        launched = {}
+        deadline = time.time() + 60
+        while time.time() < deadline and not launched:
+            launched = autoscaler.update()["launched"]
+            time.sleep(0.5)
+        assert launched.get("srv") == 1, (
+            f"unplaceable replica never became node-autoscaler demand: {launched}")
+        th.join(timeout=120)
+        assert not th.is_alive() and not err, f"app never became healthy: {err}"
+        h = serve.get_deployment_handle("Pinned", "pinned")
+        assert h.remote("x").result(timeout=30) == "ok"
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
         shutdown()
         cluster.shutdown()
 

@@ -178,6 +178,18 @@ def test_overload_storm_scenario_smoke():
     assert report["invariants"]["faults_visible_in_metrics"]["ok"]
 
 
+def test_autoscale_flap_scenario_smoke():
+    """The scale-plane acceptance scenario: chaos-delayed replica startup
+    (site scale.replica.start) under sustained load — the policy upscales,
+    the replica set grows, and the applied decision sequence contains no
+    direction flip inside the cooldown window."""
+    report = run_scenario("autoscale_flap", seed=11, quick=True)
+    assert report["ok"], report
+    assert report["details"]["replicas"] >= 2
+    assert any(d["action"] == "upscale"
+               for d in report["details"]["applied_decisions"])
+
+
 def test_same_seed_replays_identical_injection_sequence():
     """The replay contract, asserted on two REAL runs: identical seed +
     schedule + workload => byte-identical normalized injection logs."""
