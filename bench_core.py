@@ -615,6 +615,121 @@ def bench_checkpoint_save_restore(n_bytes):
            detail=detail)
 
 
+def bench_allreduce_gbps(n_bytes):
+    """Collective-plane A/B (ISSUE-12 acceptance): fp32 ring vs fp32
+    coordinator vs int8 ring allreduce of one >= 1 MiB tensor across a
+    2-rank gang. Effective GB/s = input tensor bytes / wall seconds per op
+    (algorithmic bandwidth). Arms interleave round-robin so drift hits all
+    three equally; medians reported."""
+    from ray_tpu import collective as col
+
+    world = 2
+    n = max(1 << 20, n_bytes) // 4  # fp32 elements, >= 1 MiB
+    reps = max(1, int(3 * SCALE))
+    rounds_per_rep = 3
+
+    @rt.remote
+    class Member(col.CollectiveActorMixin):
+        def arm(self, rank, kind, rounds, n):
+            x = np.full((n,), rank + 1.0, np.float32)
+            kwargs = ({"transport": "coordinator"} if kind == "coord"
+                      else {"quantization": "int8"} if kind == "int8"
+                      else {})
+            col.barrier(group_name="bench")  # start the clock together
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                out = col.allreduce(x, group_name="bench", **kwargs)
+            elapsed = time.perf_counter() - t0
+            assert abs(float(out[0]) - 3.0) < 0.1  # 1+2, quant within codec err
+            return elapsed
+
+    members = [Member.options(max_concurrency=2).remote() for _ in range(world)]
+    col.create_collective_group(members, world, [0, 1], group_name="bench")
+    times: dict = {"ring": [], "coord": [], "int8": []}
+    settle()
+    for _rep in range(reps):
+        for kind in ("coord", "ring", "int8"):  # interleaved A/B/C
+            got = rt.get([m.arm.remote(i, kind, rounds_per_rep, n)
+                          for i, m in enumerate(members)], timeout=600)
+            times[kind].append(max(got) / rounds_per_rep)
+    med = {k: sorted(v)[len(v) // 2] for k, v in times.items()}
+    nbytes = n * 4
+    gbs = {k: nbytes / s / 1e9 for k, s in med.items()}
+    col.destroy_collective_group("bench")
+    report(
+        "allreduce_gbps", nbytes / 1e9, med["ring"], unit="GB/s",
+        detail={
+            "tensor_mib": nbytes >> 20, "world": world,
+            "coordinator_fp32_gb_s": round(gbs["coord"], 3),
+            "ring_fp32_gb_s": round(gbs["ring"], 3),
+            "ring_int8_gb_s": round(gbs["int8"], 3),
+            "ring_vs_coordinator_x": round(gbs["ring"] / gbs["coord"], 2),
+            "int8_vs_coordinator_x": round(gbs["int8"] / gbs["coord"], 2),
+        },
+    )
+
+
+def bench_train_step_overlap(n_steps):
+    """Train-plane A/B (ISSUE-12): a data-parallel step whose backward
+    produces 8 x 1 MiB grad buckets with real numpy compute between them —
+    overlap ON pushes each bucket into its ring allreduce as produced
+    (BucketedGradSync streaming) vs OFF (full backward, then one sync
+    allreduce). Steps/s both arms, interleaved."""
+    from ray_tpu import collective as col
+
+    world = 2
+    layers, layer_elems = 8, 256 * 1024  # 8 x 1 MiB fp32 grads
+    steps = max(2, int(n_steps))
+
+    @rt.remote
+    class Member(col.CollectiveActorMixin):
+        def arm(self, rank, overlap, steps):
+            from ray_tpu.train.grad_sync import BucketedGradSync
+
+            rng = np.random.default_rng(rank)
+            # Per-layer backward compute sized like a real model's (backward
+            # FLOPs far exceed grad bytes): a few matmul passes per 1 MiB of
+            # grads. The transfer plane is IO-loop-thread CPU; this runs on
+            # the executor thread, which is exactly what overlap hides.
+            acts = rng.standard_normal((768, 768)).astype(np.float32)
+            col.barrier(group_name="ov_bench")
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                gs = BucketedGradSync(
+                    "ov_bench",
+                    bucket_bytes=(2 << 20) if overlap else (1 << 30))
+                for _l in range(layers):
+                    # The "backward" compute for one layer.
+                    acts = np.tanh(acts @ acts.T) * 0.1 + 0.9 * acts
+                    grad = np.full((layer_elems,), float(rank + 1), np.float32)
+                    gs.push(grad)
+                reduced = gs.finish()
+                assert len(reduced) == layers
+            return time.perf_counter() - t0
+
+    members = [Member.options(max_concurrency=2).remote() for _ in range(world)]
+    col.create_collective_group(members, world, [0, 1], group_name="ov_bench")
+    settle()
+    elapsed: dict = {}
+    for overlap in (False, True, False, True):  # interleaved pairs
+        got = rt.get([m.arm.remote(i, overlap, steps)
+                      for i, m in enumerate(members)], timeout=600)
+        elapsed.setdefault(overlap, []).append(max(got))
+    on = min(elapsed[True])
+    off = min(elapsed[False])
+    col.destroy_collective_group("ov_bench")
+    report(
+        "train_step_overlap", steps, on, unit="steps/s",
+        detail={
+            "overlap_on_steps_s": round(steps / on, 2),
+            "overlap_off_steps_s": round(steps / off, 2),
+            "overlap_speedup_x": round(off / on, 3),
+            "grad_mib_per_step": layers * layer_elems * 4 >> 20,
+            "world": world,
+        },
+    )
+
+
 def bench_wait_1k_refs(n_rounds):
     refs = [rt.put(i) for i in range(1000)]
 
@@ -657,6 +772,8 @@ def main():
         (bench_put_gigabytes, int(512 * 1024 * 1024 * SCALE)),
         (bench_large_object_pull, int(64 * 1024 * 1024 * SCALE)),
         (bench_checkpoint_save_restore, int(64 * 1024 * 1024 * SCALE)),
+        (bench_allreduce_gbps, 4 * 1024 * 1024),
+        (bench_train_step_overlap, max(2, int(8 * SCALE))),
         (bench_wait_1k_refs, max(1, int(5 * SCALE))),
         (bench_pg_create_removal, int(200 * SCALE)),
     ]

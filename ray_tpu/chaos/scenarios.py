@@ -812,12 +812,102 @@ def _scn_ckpt_kill_mid_save(seed: int, quick: bool) -> dict:
     }
 
 
+def _scn_ring_link_loss(seed: int, quick: bool) -> dict:
+    """Ring-collective frames lost in flight: round 1 drops every rank's
+    2nd send (the frame never reaches the wire), round 2 corrupts the 3rd
+    (poisoned key — the discarded-after-integrity-failure shape). Both
+    rounds must fail on EVERY rank with a typed CollectiveError inside the
+    step deadline — never a hang — via the abort fan-out, round 3 must
+    complete cleanly on the same gang (per-op state fully reaped), and the
+    coordinator's payload-byte counter must stay at zero throughout (the
+    ring path carries no tensor byte through the coordinator even while
+    failing)."""
+    import ray_tpu as rt
+    from ray_tpu.core.api import Cluster, init
+
+    cfg = _fresh_config()
+    # Tight step deadline: a lost frame must surface typed in ~2s.
+    cfg.collective_ring_step_timeout_s = 2.0
+    cfg.chaos_spec = json.dumps({
+        "seed": seed,
+        "rules": [
+            # Per-process counters: every rank drops its own 2nd ring send
+            # (reduce-scatter step 1 of round 1)...
+            {"site": "collective.ring.send", "kind": "drop", "nth": 2},
+            # ...and corrupts its 3rd counted-by-this-rule send (reduce-
+            # scatter step 1 of round 2; rule order matters — the drop rule
+            # consumes its firing hit before this one counts it).
+            {"site": "collective.ring.send", "kind": "corrupt", "nth": 3},
+        ],
+    })
+    _plan.install_from_json(cfg.chaos_spec)
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=4)
+    init(address=cluster.address, config=cfg)
+    from ray_tpu import collective as col
+
+    n = 8192 if quick else 65536
+    world = 3
+
+    @rt.remote
+    class Member(col.CollectiveActorMixin):
+        def round(self, rank, n):
+            import numpy as np
+            from ray_tpu import collective as c
+
+            try:
+                out = c.allreduce(np.full((n,), rank + 1.0, np.float32),
+                                  group_name="ring_chaos", timeout=30.0)
+                return ("ok", float(out[0]))
+            except c.CollectiveError as e:
+                return ("collective_error", str(e)[:120])
+            except Exception as e:  # noqa: BLE001 - anything else is a finding
+                return ("unexpected", f"{type(e).__name__}: {e}"[:160])
+
+    members = [Member.options(max_concurrency=2).remote() for _ in range(world)]
+    col.create_collective_group(members, world, list(range(world)),
+                                group_name="ring_chaos")
+    rounds = []
+    for rnd, want in (("drop", "collective_error"),
+                      ("corrupt", "collective_error"),
+                      ("clean", "ok")):
+        t0 = time.monotonic()
+        outs = rt.get([m.round.remote(i, n) for i, m in enumerate(members)],
+                      timeout=60)
+        elapsed = time.monotonic() - t0
+        rounds.append({"round": rnd, "outs": outs,
+                       "elapsed_s": round(elapsed, 2)})
+        _require(all(kind == want for kind, _ in outs),
+                 f"round {rnd!r}: expected every rank {want}, got {outs}")
+        _require(elapsed < 25,
+                 f"round {rnd!r} took {elapsed:.1f}s — a timed-out wait is a "
+                 "hang in disguise (step timeout is 2s)")
+    _require(rounds[-1]["outs"][0][1] == 6.0,  # 1+2+3
+             f"clean round produced a wrong sum: {rounds[-1]['outs']}")
+    from ray_tpu.collective.collective import _GROUP_PREFIX
+
+    stats = rt.get(rt.get_actor(_GROUP_PREFIX + "ring_chaos").get_stats.remote(),
+                   timeout=15)
+    _require(stats == {"payload_in": 0, "payload_out": 0},
+             f"coordinator carried tensor payload on the ring path: {stats}")
+    col.destroy_collective_group("ring_chaos")
+    return {
+        "cluster": cluster,
+        "details": {"rounds": rounds, "coordinator_stats": stats},
+        # The driver process injects nothing (ranks are actor processes);
+        # each of the 3 ranks drops once and corrupts once, and survives.
+        "min_injections": 0,
+        "min_metric_injections": 2 * world,
+    }
+
+
 SCENARIOS: dict = {
     "worker_kill": _scn_worker_kill,
     "pull_source_death": _scn_pull_source_death,
     "controller_restart": _scn_controller_restart,
     "mac_corrupt_storm": _scn_mac_corrupt_storm,
     "tpu_preempt_drain": _scn_tpu_preempt_drain,
+    "ring_link_loss": _scn_ring_link_loss,
     "overload_storm": _scn_overload_storm,
     "autoscale_flap": _scn_autoscale_flap,
     "ckpt_kill_mid_save": _scn_ckpt_kill_mid_save,

@@ -38,6 +38,19 @@ SITES: dict = {
                      "caller's connection-loss retry resubmits and the replay's "
                      "duplicate indices dedup; delay: slow token-stream tolerance",
     },
+    "collective.ring.send": {
+        "layer": "collective",
+        "kinds": {"drop", "corrupt", "delay"},
+        "desc": "one ring-collective raw frame about to ship to the successor "
+                "(drop: never reaches the wire; corrupt: ships under a "
+                "poisoned key — the discarded-after-integrity-failure shape, "
+                "since a real bit flip is rejected by the raw lane's MAC "
+                "with the connection; delay: slow link)",
+        "exercises": "step-deadline -> typed CollectiveError (never a hang) + "
+                     "abort fan-out around the ring so every blocked rank "
+                     "fails with the origin attributed (scenario "
+                     "ring_link_loss); delay: step-timeout tolerance",
+    },
     # -- L2: node daemon / object plane ---------------------------------
     "node.chunk.serve": {
         "layer": "node",

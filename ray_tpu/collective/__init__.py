@@ -13,8 +13,11 @@ reference's named-actor + KV rendezvous (collective.py:71 GroupManager).
 """
 from ray_tpu.collective.collective import (
     CollectiveActorMixin,
+    CollectiveWork,
     allgather,
+    allgather_async,
     allreduce,
+    allreduce_async,
     barrier,
     broadcast,
     create_collective_group,
@@ -25,13 +28,19 @@ from ray_tpu.collective.collective import (
     recv,
     reduce,
     reducescatter,
+    reducescatter_async,
     send,
 )
+from ray_tpu.collective.ring import CollectiveError
 
 __all__ = [
     "CollectiveActorMixin",
+    "CollectiveError",
+    "CollectiveWork",
     "allgather",
+    "allgather_async",
     "allreduce",
+    "allreduce_async",
     "barrier",
     "broadcast",
     "create_collective_group",
@@ -42,5 +51,6 @@ __all__ = [
     "recv",
     "reduce",
     "reducescatter",
+    "reducescatter_async",
     "send",
 ]

@@ -1,9 +1,22 @@
-"""Host-plane collectives over a named coordinator actor.
+"""Host-plane collectives: ring data path + named coordinator rendezvous.
 
 Each group is a detached named actor (`raytpu_collective:<name>`) holding
 per-round mailboxes; ranks rendezvous by name (reference: GroupManager +
 named-actor rendezvous, collective.py:71). Ops are synchronous and round-
 numbered per (group, op) so repeated calls pipeline correctly.
+
+Two transports:
+
+* ``ring`` (the default for world > 1): tensor bytes move rank-to-rank over
+  peer worker RPC connections on the zero-pickle raw-frame lane — ring
+  reduce-scatter + allgather, optional EQuARX-style int8 block quantization
+  (see ring.py / quantize.py). The coordinator actor carries ONLY
+  membership/epoch/rendezvous traffic; its own payload-byte counter
+  (``get_stats``) proves no tensor byte transits it.
+* ``coordinator`` (legacy/fallback, and always the rendezvous plane):
+  values ride the pickled actor-call lane through the coordinator's
+  mailboxes. O(world^2 * bytes) through one process — fine for barriers
+  and small objects, wrong for gradient sync.
 
 Reductions run on numpy (host memory). For device arrays inside a compiled
 program, use the mesh collectives (jax psum / all_gather) — that path never
@@ -18,10 +31,24 @@ from typing import Any, Optional
 import numpy as np
 
 _GROUP_PREFIX = "raytpu_collective:"
+# Reaped-round guard memory in the coordinator (see _GroupCoordinator
+# ._consumed): enough to cover any realistic lost-reply retry window while
+# keeping a step-per-second gang's footprint flat over unbounded epochs.
+_CONSUMED_CAP = 4096
 # Process-scoped registry (reference: GroupManager, collective.py:71). Actor
 # methods may run on different pool threads, so thread-local scope would lose
 # the group between calls.
 _process_groups: dict = {}
+
+
+def _payload_nbytes(v: Any) -> int:
+    """Tensor-payload accounting for the coordinator shim: how many bulk
+    bytes a mailbox value carries. Control scalars/strings count zero."""
+    if isinstance(v, np.ndarray):
+        return v.nbytes
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return len(v)
+    return 0
 
 
 class _GroupCoordinator:
@@ -29,9 +56,16 @@ class _GroupCoordinator:
     access is single-threaded on the actor loop, and waiters park on
     asyncio.Events server-side — one RPC per rank per collective, no client
     polling (reference keeps data on NCCL and the actor for rendezvous only;
-    here payloads are host-plane by design — see module docstring)."""
+    here the ring transport keeps data on peer links the same way, and this
+    actor counts every payload byte it is asked to carry so the zero-bytes
+    invariant of the ring path is checkable at runtime)."""
 
     def __init__(self, world_size: int):
+        import uuid
+
+        # Instance id: rings key on it so a destroyed-and-recreated group
+        # (whose epochs restart at 1) can never alias a stale ring.
+        self.boot = uuid.uuid4().hex
         self.world_size = world_size
         self.rounds: dict[str, dict[int, Any]] = {}
         self.done: dict[str, Any] = {}
@@ -43,15 +77,36 @@ class _GroupCoordinator:
         # mailboxes left over from a dead one. A re-joining rank replaces its
         # stale lobby entry (the old process is presumed dead).
         self.epoch = 0
-        self._lobby: dict[int, str] = {}  # rank -> join id
+        self._lobby: dict[int, tuple] = {}  # rank -> (join id, worker addr)
         self._assigned: dict[str, int] = {}  # join id -> epoch
         self._join_event = asyncio.Event()
+        # Worker RPC addresses of the current epoch's gang (ring rendezvous).
+        self.ring_addrs: dict[int, Optional[str]] = {}
+        # Payload-byte counting shim: bulk bytes contributed to (in) and
+        # served from (out) this actor's mailboxes. The ring path must keep
+        # both flat — asserted by tests, exposed for operators.
+        self.stats = {"payload_in": 0, "payload_out": 0}
+        # Keys whose mailbox was fully served and reaped (collect: dst
+        # fetched; exchange/publish: every rank acked). A rank re-arming one
+        # of these after a lost reply must not recreate a ghost box nobody
+        # will ever complete — collect re-acks non-dst ranks, exchange/
+        # publish fail loud (the values are gone). Insertion-ordered and
+        # CAPPED (an epoch is unbounded in time — a gang calling barrier()
+        # every step for 1M steps must not pin 1M keys in this detached
+        # actor); evicting a key merely narrows the lost-reply guard to the
+        # last _CONSUMED_CAP rounds, far beyond any reply-retry window.
+        self._consumed: dict = {}  # key -> None (ordered-set semantics)
+        self.consumed_evicted = 0
 
     async def get_world_size(self) -> int:
         return self.world_size
 
-    async def join_begin(self, rank: int, join_id: str) -> None:
-        self._lobby[rank] = join_id
+    async def get_stats(self) -> dict:
+        return dict(self.stats)
+
+    async def join_begin(self, rank: int, join_id: str,
+                         address: Optional[str] = None) -> None:
+        self._lobby[rank] = (join_id, address)
         if len(self._lobby) == self.world_size:
             self.epoch += 1
             # Clear mailboxes BEFORE publishing the epoch: once a rank can
@@ -60,7 +115,9 @@ class _GroupCoordinator:
             self.done.clear()
             self.acks.clear()
             self._events.clear()
-            for jid in self._lobby.values():
+            self._consumed.clear()
+            self.ring_addrs = {r: a for r, (_j, a) in self._lobby.items()}
+            for jid, _addr in self._lobby.values():
                 self._assigned[jid] = self.epoch
             self._lobby.clear()
             self._join_event.set()
@@ -78,22 +135,65 @@ class _GroupCoordinator:
             pass
         return self._assigned.get(join_id)
 
+    async def get_ring_info(self, epoch: int) -> Optional[dict]:
+        """Ring rendezvous: the gang's worker addresses for ``epoch``.
+        Returns None when the epoch is stale (a newer gang joined)."""
+        if epoch != self.epoch:
+            return None
+        return {"addresses": dict(self.ring_addrs), "boot": self.boot}
+
     def _ev(self, key: str) -> "asyncio.Event":
         ev = self._events.get(key)
         if ev is None:
             ev = self._events[key] = asyncio.Event()
         return ev
 
+    def _raise_reaped(self, key: str) -> None:
+        """One fail-loud shape for every reaped-round re-arm: the values
+        are gone, so parking the caller to its deadline (or busy re-arming)
+        only delays the same outcome untyped."""
+        from ray_tpu.collective.ring import CollectiveError
+
+        raise CollectiveError(
+            f"collective round {key} already completed and was reaped; "
+            "the reply to this rank was lost and cannot be recovered")
+
+    def _mark_consumed(self, key: str) -> None:
+        self._consumed[key] = None
+        while len(self._consumed) > _CONSUMED_CAP:
+            self._consumed.pop(next(iter(self._consumed)))
+            self.consumed_evicted += 1
+
+    def _contribute(self, key: str, rank: int, value: Any) -> dict:
+        box = self.rounds.setdefault(key, {})
+        if rank not in box:
+            box[rank] = value
+            self.stats["payload_in"] += _payload_nbytes(value)
+        return box
+
+    def _count_out(self, result: Any) -> None:
+        if isinstance(result, dict):
+            for v in result.values():
+                self.stats["payload_out"] += _payload_nbytes(v)
+        else:
+            self.stats["payload_out"] += _payload_nbytes(result)
+
     async def exchange(self, key: str, rank: int, value: Any, timeout: float = 30.0) -> Optional[dict]:
         """Contribute and park until every rank has; returns the full box (or
         None on timeout — callers re-arm until their own deadline). The box
         is garbage-collected once all ranks have fetched it."""
+        if key in self._consumed:
+            # Every rank already fetched and the box was reaped: a re-armed
+            # rank lost its reply for good — the values are gone. Fail loud
+            # now (typed, immediate) instead of recreating a ghost
+            # rounds[key] that parks this rank to its deadline and counts
+            # ghost payload_in bytes.
+            self._raise_reaped(key)
         if key not in self.done:
             # Not complete yet: contribute (idempotent under re-arm) and park.
             # The done-check guards re-arms AFTER completion from re-creating
             # a ghost rounds[key] that would never be collected.
-            box = self.rounds.setdefault(key, {})
-            box[rank] = value
+            box = self._contribute(key, rank, value)
             ev = self._ev(key)
             if len(box) == self.world_size:
                 self.done[key] = self.rounds.pop(key)
@@ -107,16 +207,99 @@ class _GroupCoordinator:
         if result is None:
             return None
         acked = self.acks.setdefault(key, set())
-        acked.add(rank)
+        if rank not in acked:
+            # First fetch only: a re-arm whose reply was lost (box not yet
+            # fully reaped) replays the value without inflating the
+            # operator-facing payload counters.
+            acked.add(rank)
+            self._count_out(result)
         if len(acked) == self.world_size:
             self.done.pop(key, None)
             self.acks.pop(key, None)
             self._events.pop(key, None)
             self.rounds.pop(key, None)
+            self._mark_consumed(key)
         return result
+
+    async def collect(self, key: str, rank: int, value: Any, dst_rank: int,
+                      timeout: float = 30.0) -> Optional[dict]:
+        """All ranks contribute; ONLY ``dst_rank`` receives the box (and
+        pays its transfer) — non-dst ranks get a tiny ack without parking
+        for completion. Replaces exchange() for reduce(): the legacy shape
+        shipped the full all-ranks box to every rank that then returned
+        None."""
+        if key in self._consumed:
+            # dst already fetched and the box is gone: a re-armed non-dst
+            # contribution (lost ack reply) must not recreate a ghost box
+            # nobody will complete — or count ghost payload bytes. A
+            # re-armed dst lost its reply for good: fail typed NOW (a None
+            # would make _rearm busy-spin RPCs until the full deadline).
+            if rank != dst_rank:
+                return {"ok": True}
+            self._raise_reaped(key)
+        if key not in self.done:
+            box = self._contribute(key, rank, value)
+            if len(box) == self.world_size:
+                self.done[key] = self.rounds.pop(key)
+                self._ev(key).set()
+            elif rank != dst_rank:
+                return {"ok": True}
+            else:
+                try:
+                    await asyncio.wait_for(self._ev(key).wait(), timeout)
+                except asyncio.TimeoutError:
+                    return None
+        if rank != dst_rank:
+            return {"ok": True}
+        result = self.done.get(key)
+        if result is None:
+            return None
+        self._count_out(result)
+        # Single consumer: GC as soon as dst has fetched.
+        self.done.pop(key, None)
+        self._events.pop(key, None)
+        self._mark_consumed(key)
+        return result
+
+    async def publish(self, key: str, rank: int, value: Any, src_rank: int,
+                      timeout: float = 30.0) -> Optional[dict]:
+        """``src_rank`` publishes one value; every rank receives exactly it
+        (no all-ranks box, no parking on non-src contributions). Replaces
+        exchange() for broadcast(): completion needs only src's arrival, and
+        non-src ranks no longer occupy mailbox slots with Nones."""
+        if key in self._consumed:
+            # All ranks acked and the value was reaped: a re-armed rank lost
+            # its reply for good (and a re-armed src must not republish a
+            # ghost entry nobody will ever GC). Same shape as collect()'s
+            # guard — fail loud now, not at the caller's deadline.
+            self._raise_reaped(key)
+        if rank == src_rank and key not in self.done and key not in self.acks:
+            self.stats["payload_in"] += _payload_nbytes(value)
+            self.done[key] = {"v": value}
+            self._ev(key).set()
+        if key not in self.done:
+            try:
+                await asyncio.wait_for(self._ev(key).wait(), timeout)
+            except asyncio.TimeoutError:
+                return None
+        entry = self.done.get(key)
+        if entry is None:
+            return None
+        acked = self.acks.setdefault(key, set())
+        if rank not in acked:
+            # First fetch only (same lost-reply replay shape as exchange).
+            acked.add(rank)
+            self.stats["payload_out"] += _payload_nbytes(entry["v"])
+        if len(acked) == self.world_size:
+            self.done.pop(key, None)
+            self.acks.pop(key, None)
+            self._events.pop(key, None)
+            self._mark_consumed(key)
+        return entry
 
     # point-to-point
     async def put_p2p(self, key: str, value: Any) -> None:
+        self.stats["payload_in"] += _payload_nbytes(value)
         self.done[key] = {"v": value}
         self._ev(key).set()
 
@@ -127,7 +310,10 @@ class _GroupCoordinator:
             except asyncio.TimeoutError:
                 return None
         self._events.pop(key, None)
-        return self.done.pop(key, None)
+        entry = self.done.pop(key, None)
+        if entry is not None:
+            self.stats["payload_out"] += _payload_nbytes(entry["v"])
+        return entry
 
 
 class _GroupHandle:
@@ -139,6 +325,7 @@ class _GroupHandle:
         self.join_id = join_id
         self.epoch: Optional[int] = None  # resolved at first collective
         self.counters: dict[str, int] = {}
+        self._ring = None  # lazily-established ring transport
 
     def ensure_epoch(self, timeout: float = 120.0) -> int:
         """Block until the whole gang has joined and an epoch is assigned.
@@ -167,16 +354,43 @@ class _GroupHandle:
                 self.epoch = epoch
                 return epoch
 
+    def ensure_ring(self, timeout: float = 60.0):
+        """Establish (or reuse) the peer-to-peer ring for this group's
+        current epoch. Addresses come from the coordinator — its only duty
+        on the ring path."""
+        import ray_tpu as rt
+        from ray_tpu.collective import ring as _ring
+        from ray_tpu.core import api as _api
+
+        if self._ring is not None and self._ring.healthy():
+            return self._ring
+        epoch = self.ensure_epoch()
+        core = _api._require_worker()
+        info = rt.get(self.actor.get_ring_info.remote(epoch), timeout=30)
+        if info is None:
+            raise _ring.CollectiveError(
+                f"group {self.name!r}: epoch {epoch} is stale (a newer gang "
+                "joined); re-init the collective group")
+        addrs = {int(r): a for r, a in info["addresses"].items()}
+        missing = [r for r, a in addrs.items() if not a]
+        if missing:
+            raise _ring.CollectiveError(
+                f"group {self.name!r}: ranks {missing} joined without a "
+                "worker transport address; ring transport unavailable")
+        self._ring = _ring.establish_sync(
+            core, self.name, info.get("boot", ""), epoch, self.rank,
+            self.world_size, addrs, timeout)
+        return self._ring
+
     def next_key(self, op: str) -> str:
         epoch = self.ensure_epoch()
         c = self.counters.get(op, 0)
         self.counters[op] = c + 1
         return f"e{epoch}:{op}:{c}"
 
-    def exchange(self, op: str, value: Any, timeout: float = 120.0) -> dict:
-        """All ranks contribute; returns {rank: value} for all ranks. One
-        round trip in the common case: the coordinator parks the call until
-        the box is complete (re-contribution on re-arm is idempotent)."""
+    def _rearm(self, method: str, op: str, args: tuple, timeout: float) -> dict:
+        """Common client loop: short server-side parks re-armed until the
+        caller's own deadline."""
         import ray_tpu as rt
 
         key = self.next_key(op)
@@ -186,11 +400,26 @@ class _GroupHandle:
             if remaining <= 0:
                 raise TimeoutError(f"collective {op} timed out in group {self.name}")
             box = rt.get(
-                self.actor.exchange.remote(key, self.rank, value, min(remaining, 30.0)),
+                getattr(self.actor, method).remote(
+                    key, self.rank, *args, min(remaining, 30.0)),
                 timeout=min(remaining, 30.0) + 30,
             )
             if box is not None:
                 return box
+
+    def exchange(self, op: str, value: Any, timeout: float = 120.0) -> dict:
+        """All ranks contribute; returns {rank: value} for all ranks. One
+        round trip in the common case: the coordinator parks the call until
+        the box is complete (re-contribution on re-arm is idempotent)."""
+        return self._rearm("exchange", op, (value,), timeout)
+
+    def collect(self, op: str, value: Any, dst_rank: int, timeout: float = 120.0) -> dict:
+        """All contribute, only dst receives the box (see coordinator)."""
+        return self._rearm("collect", op, (value, dst_rank), timeout)
+
+    def publish(self, op: str, value: Any, src_rank: int, timeout: float = 120.0) -> dict:
+        """src publishes, every rank receives {'v': value}."""
+        return self._rearm("publish", op, (value, src_rank), timeout)
 
 
 def _groups() -> dict:
@@ -202,6 +431,7 @@ def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> None:
     """Join (creating if needed) the named group from this process."""
     import ray_tpu as rt
+    from ray_tpu.core import api as _api
 
     if backend not in ("host", "xla"):
         raise ValueError(f"unknown backend {backend!r}; host (this module) or "
@@ -229,7 +459,10 @@ def init_collective_group(world_size: int, rank: int,
     import uuid
 
     join_id = uuid.uuid4().hex
-    rt.get(actor.join_begin.remote(rank, join_id), timeout=30)
+    # This process's worker RPC address is the ring-transport endpoint the
+    # gang's neighbors will dial (raw-frame lane, worker-to-worker).
+    address = _api._require_worker().address
+    rt.get(actor.join_begin.remote(rank, join_id, address), timeout=30)
     _groups()[group_name] = _GroupHandle(name, actor, world_size, rank, join_id)
 
 
@@ -258,8 +491,10 @@ def create_collective_group(actors: list, world_size: int, ranks: list[int],
 
 def destroy_collective_group(group_name: str = "default") -> None:
     import ray_tpu as rt
+    from ray_tpu.collective import ring as _ring
 
     g = _groups().pop(group_name, None)
+    _ring.drop_group(_GROUP_PREFIX + group_name)
     if g is not None:
         actor = g.actor
     else:
@@ -294,8 +529,43 @@ def get_collective_group_size(group_name: str = "default") -> int:
     return _group(group_name).world_size
 
 
+def _check_rank(g: _GroupHandle, rank: int, what: str) -> None:
+    """An out-of-range peer rank must fail loud at entry: on the ring path
+    it would silently make every rank return None (reduce) or hang the
+    line (broadcast); on the coordinator path it leaks the completed box
+    (nobody consumes/GCs it) until the next epoch."""
+    if not 0 <= rank < g.world_size:
+        raise ValueError(
+            f"{what}={rank} out of range for world_size={g.world_size} "
+            f"in group {g.name!r}")
+
+
+def _is_float_dtype(dt) -> bool:
+    """True for any floating dtype INCLUDING ml_dtypes (bfloat16 registers
+    with numpy as kind 'V', so a bare ``dtype.kind == 'f'`` check silently
+    misclassifies the plane's flagship dtype)."""
+    dt = np.dtype(dt)
+    if dt.kind == "f":
+        return True
+    try:
+        import ml_dtypes
+
+        ml_dtypes.finfo(dt)
+        return True
+    except Exception:
+        return False
+
+
 def _to_np(x):
     return np.asarray(x)
+
+
+def _backing(arr: np.ndarray) -> bytearray:
+    """One-copy mutable byte backing for a C-contiguous array.
+    ``bytearray(arr.tobytes())`` would copy twice, and ``memoryview(arr)``
+    fails on ml_dtypes dtypes (bf16) — a uint8 reinterpret view works for
+    any itemsize."""
+    return bytearray(arr.reshape(-1).view(np.uint8))
 
 
 _REDUCERS = {
@@ -306,47 +576,316 @@ _REDUCERS = {
 }
 
 
-def allreduce(tensor, op: str = "sum", group_name: str = "default"):
+# ---------------------------------------------------------------------------
+# Async work handles (ring transport)
+# ---------------------------------------------------------------------------
+
+
+class CollectiveWork:
+    """A collective in flight on the ring transport. ``result()`` blocks
+    until the op completes and returns the op's output; exceptions from the
+    ring (typed CollectiveError) re-raise there. The train plane's bucketed
+    overlap holds a list of these while packing the next bucket."""
+
+    def __init__(self, fut, post, op_timeout: float):
+        self._fut = fut
+        self._post = post
+        self._op_timeout = op_timeout
+        self._resolved = False
+        self._value = None
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the op. With no ``timeout`` the wait is bounded by the
+        OP's timeout plus grace — never unbounded: the coroutine enforces
+        its own deadline, so an overrun here means the worker IO loop died
+        mid-op and the never-a-hang contract still owes a typed error. An
+        explicit shorter ``timeout`` is a poll: it raises TimeoutError and
+        the op may still complete later."""
+        if not self._resolved:
+            import concurrent.futures
+
+            bound = self._op_timeout + 5.0
+            eff = bound if timeout is None else min(timeout, bound)
+            try:
+                out = self._fut.result(eff)
+            except (concurrent.futures.TimeoutError, TimeoutError):
+                if timeout is not None and timeout < bound:
+                    # The caller's own poll deadline; op still running. The
+                    # BUILTIN TimeoutError: on 3.10 concurrent.futures'
+                    # is a distinct class, and the documented poll contract
+                    # (and the coordinator transport) use the builtin.
+                    raise TimeoutError(
+                        f"collective op still in flight after {timeout}s"
+                    ) from None
+                from ray_tpu.collective import ring as _ring
+
+                raise _ring.CollectiveError(
+                    f"ring collective produced no result within "
+                    f"{self._op_timeout}s + grace (worker IO loop stalled "
+                    "or gone)") from None
+            self._value = self._post(out) if self._post is not None else out
+            self._resolved = True
+            # Drop the future and the post closure: they pin the op's input
+            # copies (backing bytearray, source array) — dead weight once
+            # the result exists, and a caller holding many resolved handles
+            # (a step's bucket list) would otherwise hold ~2x tensor bytes
+            # per bucket for the handle's lifetime.
+            self._fut = None
+            self._post = None
+        return self._value
+
+
+class _DoneWork(CollectiveWork):
+    def __init__(self, value):
+        self._resolved = True
+        self._value = value
+
+    def done(self) -> bool:
+        return True
+
+    def result(self, timeout: Optional[float] = None):
+        return self._value
+
+
+def _use_ring(g: _GroupHandle, transport: str) -> bool:
+    if transport not in ("auto", "ring", "coordinator"):
+        raise ValueError(f"unknown transport {transport!r} "
+                         "(auto | ring | coordinator)")
+    return transport != "coordinator" and g.world_size > 1
+
+
+def _observe_gbs(nbytes: int, elapsed: float, transport: str,
+                 quantization: Optional[str]) -> None:
+    from ray_tpu.collective import ring as _ring
+
+    if elapsed > 0:
+        _ring._gbs_hist.observe(
+            nbytes / elapsed / 1e9,
+            tags={"transport": transport, "quant": quantization or "none"})
+
+
+def _launch(g: _GroupHandle, coro_factory, post, op_timeout: float):
+    """Allocate the op counter (caller thread, deterministic order) and run
+    the op coroutine on the worker IO loop."""
+    rng = g.ensure_ring()
+    ctr = rng.next_ctr()
+    fut = asyncio.run_coroutine_threadsafe(coro_factory(rng, ctr), rng.core.loop)
+    return CollectiveWork(fut, post, op_timeout)
+
+
+def allreduce_async(tensor, op: str = "sum", group_name: str = "default", *,
+                    quantization: Optional[str] = None,
+                    timeout: float = 120.0) -> CollectiveWork:
+    """Ring allreduce, asynchronously: returns a :class:`CollectiveWork`
+    whose ``result()`` is the reduced array (dtype matches the input, even
+    with ``quantization="int8"``). All ranks must launch their collectives
+    in the same order — the op counter is the only frame<->op match."""
+    from ray_tpu.core import api as _api
+
     g = _group(group_name)
-    box = g.exchange("allreduce", _to_np(tensor))
+    arr = np.ascontiguousarray(_to_np(tensor))
+    if quantization not in (None, "int8"):
+        raise ValueError(f"unknown quantization {quantization!r} (int8 or None)")
+    if quantization == "int8":
+        if op != "sum":
+            raise ValueError("int8 quantization supports op='sum' only")
+        if not _is_float_dtype(arr.dtype):
+            raise ValueError("int8 quantization needs a floating-point input")
+    orig_dtype, shape = arr.dtype, arr.shape
+    if g.world_size == 1:
+        return _DoneWork(arr.copy())
+    acc_dtype = np.dtype(np.float32) if quantization else arr.dtype
+    src = arr.astype(np.float32) if quantization and arr.dtype != acc_dtype else arr
+    buf = _backing(src)
+    # Adopted cluster config (NOT get_config()): the block size is part of
+    # the wire contract — every rank must quantize with the same one, and
+    # spawned workers only see head-pushed knobs through core.config.
+    block = _api._require_worker().config.collective_quant_block
+    t0 = time.perf_counter()
+    nbytes = arr.size * orig_dtype.itemsize
+
+    def factory(rng, ctr):
+        from ray_tpu.collective import ring as _ring
+
+        return _ring._allreduce(rng, ctr, buf, acc_dtype, arr.size, op,
+                                quantization, block, timeout)
+
+    def post(outbuf):
+        out = np.frombuffer(outbuf, dtype=acc_dtype).reshape(shape)
+        if quantization and orig_dtype != acc_dtype:
+            out = out.astype(orig_dtype)
+        _observe_gbs(nbytes, time.perf_counter() - t0, "ring", quantization)
+        return out
+
+    return _launch(g, factory, post, timeout)
+
+
+def allreduce(tensor, op: str = "sum", group_name: str = "default", *,
+              quantization: Optional[str] = None, transport: str = "auto",
+              timeout: float = 120.0):
+    g = _group(group_name)
+    if _use_ring(g, transport):
+        return allreduce_async(tensor, op, group_name,
+                               quantization=quantization,
+                               timeout=timeout).result()
+    if quantization is not None and g.world_size > 1:
+        raise ValueError("quantization requires the ring transport")
+    t0 = time.perf_counter()
+    arr = _to_np(tensor)
+    box = g.exchange("allreduce", arr, timeout=timeout)
     arrs = [box[r] for r in sorted(box)]
-    return _REDUCERS[op](arrs)
+    out = _REDUCERS[op](arrs)
+    _observe_gbs(arr.size * arr.dtype.itemsize, time.perf_counter() - t0,
+                 "coordinator", None)
+    return out
 
 
-def reduce(tensor, dst_rank: int = 0, op: str = "sum", group_name: str = "default"):
+def reduce(tensor, dst_rank: int = 0, op: str = "sum",
+           group_name: str = "default", *, transport: str = "auto",
+           timeout: float = 120.0):
     g = _group(group_name)
-    box = g.exchange("reduce", _to_np(tensor))
+    _check_rank(g, dst_rank, "dst_rank")
+    arr = np.ascontiguousarray(_to_np(tensor))
+    if _use_ring(g, transport):
+        dtype, shape = arr.dtype, arr.shape
+        buf = _backing(arr)
+
+        def factory(rng, ctr):
+            from ray_tpu.collective import ring as _ring
+
+            return _ring._reduce_line(rng, ctr, buf, dtype, arr.size, op,
+                                      dst_rank, timeout)
+
+        def post(outbuf):
+            if outbuf is None:
+                return None
+            return np.frombuffer(outbuf, dtype=dtype).reshape(shape)
+
+        return _launch(g, factory, post, timeout).result()
+    # Legacy path: all contribute, ONLY dst receives the box (collect);
+    # non-dst ranks no longer download the full all-ranks box to return None.
+    box = g.collect("reduce", arr, dst_rank, timeout=timeout)
     if g.rank != dst_rank:
         return None
     arrs = [box[r] for r in sorted(box)]
     return _REDUCERS[op](arrs)
 
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default", *,
+              transport: str = "auto", timeout: float = 120.0):
     g = _group(group_name)
+    _check_rank(g, src_rank, "src_rank")
+    if _use_ring(g, transport):
+        if g.rank == src_rank:
+            arr = np.ascontiguousarray(_to_np(tensor))
+            meta = {"dtype": arr.dtype, "shape": tuple(arr.shape),
+                    "nbytes": arr.nbytes}
+            buf = _backing(arr)
+        else:
+            arr, meta, buf = None, None, None
+
+        def factory(rng, ctr):
+            from ray_tpu.collective import ring as _ring
+
+            return _ring._broadcast(rng, ctr, buf, meta, src_rank, timeout)
+
+        def post(out):
+            outbuf, ometa = out
+            return np.frombuffer(outbuf, dtype=ometa["dtype"]).reshape(
+                ometa["shape"])
+
+        return _launch(g, factory, post, timeout).result()
+    # Legacy path: src publishes once; non-src ranks neither contribute a
+    # mailbox slot nor wait on anything but src's arrival (publish).
     payload = _to_np(tensor) if g.rank == src_rank else None
-    box = g.exchange("broadcast", payload)
-    return box[src_rank]
+    got = g.publish("broadcast", payload, src_rank, timeout=timeout)
+    return got["v"]
 
 
-def allgather(tensor, group_name: str = "default") -> list:
+def allgather(tensor, group_name: str = "default", *, transport: str = "auto",
+              timeout: float = 120.0) -> list:
     g = _group(group_name)
-    box = g.exchange("allgather", _to_np(tensor))
+    arr = np.ascontiguousarray(_to_np(tensor))
+    if _use_ring(g, transport):
+        return allgather_async(arr, group_name, timeout=timeout).result()
+    box = g.exchange("allgather", arr, timeout=timeout)
     return [box[r] for r in sorted(box)]
 
 
-def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
+def allgather_async(tensor, group_name: str = "default", *,
+                    timeout: float = 120.0) -> CollectiveWork:
+    """Ring allgather: result() is the list of every rank's array, rank
+    order. World 1 completes immediately."""
+    g = _group(group_name)
+    arr = np.ascontiguousarray(_to_np(tensor))
+    if g.world_size == 1:
+        return _DoneWork([arr.copy()])
+    dtype, shape, n = arr.dtype, arr.shape, arr.size
+    W, r = g.world_size, g.rank
+    buf = bytearray(W * arr.nbytes)
+    item = dtype.itemsize
+    buf[r * n * item:(r + 1) * n * item] = memoryview(arr.reshape(-1).view(np.uint8))
+
+    def factory(rng, ctr):
+        from ray_tpu.collective import ring as _ring
+
+        return _ring._allgather(rng, ctr, buf, dtype, n, timeout)
+
+    def post(outbuf):
+        flat = np.frombuffer(outbuf, dtype=dtype)
+        return [flat[c * n:(c + 1) * n].reshape(shape) for c in range(W)]
+
+    return _launch(g, factory, post, timeout)
+
+
+def reducescatter(tensor, op: str = "sum", group_name: str = "default", *,
+                  transport: str = "auto", timeout: float = 120.0):
     """Each rank contributes a [world, ...] stack; rank r gets the reduction
     of everyone's r-th shard."""
     g = _group(group_name)
-    t = _to_np(tensor)
+    t = np.ascontiguousarray(_to_np(tensor))
     if t.shape[0] != g.world_size:
         raise ValueError(
             f"reducescatter input leading dim {t.shape[0]} != world {g.world_size}"
         )
-    box = g.exchange("reducescatter", t)
+    if _use_ring(g, transport):
+        return reducescatter_async(t, op, group_name,
+                                   timeout=timeout).result()
+    box = g.exchange("reducescatter", t, timeout=timeout)
     arrs = [box[r][g.rank] for r in sorted(box)]
     return _REDUCERS[op](arrs)
+
+
+def reducescatter_async(tensor, op: str = "sum",
+                        group_name: str = "default", *,
+                        timeout: float = 120.0) -> CollectiveWork:
+    g = _group(group_name)
+    t = np.ascontiguousarray(_to_np(tensor))
+    if t.shape[0] != g.world_size:
+        raise ValueError(
+            f"reducescatter input leading dim {t.shape[0]} != world {g.world_size}"
+        )
+    if g.world_size == 1:
+        return _DoneWork(t[0].copy())
+    dtype = t.dtype
+    slice_shape = t.shape[1:]
+    n_per = t[0].size
+    r = g.rank
+    buf = _backing(t)
+
+    def factory(rng, ctr):
+        from ray_tpu.collective import ring as _ring
+
+        return _ring._reducescatter(rng, ctr, buf, dtype, n_per, op, timeout)
+
+    def post(outbuf):
+        flat = np.frombuffer(outbuf, dtype=dtype)
+        return flat[r * n_per:(r + 1) * n_per].reshape(slice_shape).copy()
+
+    return _launch(g, factory, post, timeout)
 
 
 def barrier(group_name: str = "default") -> None:
@@ -357,6 +896,7 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     import ray_tpu as rt
 
     g = _group(group_name)
+    _check_rank(g, dst_rank, "dst_rank")
     chan = f"p2p:{g.rank}->{dst_rank}"
     key = f"{chan}:{g.next_key(chan)}"
     rt.get(g.actor.put_p2p.remote(key, _to_np(tensor)), timeout=60)
@@ -366,16 +906,16 @@ def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
     import ray_tpu as rt
 
     g = _group(group_name)
+    _check_rank(g, src_rank, "src_rank")
     chan = f"p2p:{src_rank}->{g.rank}"
     key = f"{chan}:{g.next_key(chan)}"
-    deadline = time.monotonic() + timeout
-    while True:
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            raise TimeoutError(f"recv from {src_rank} timed out")
-        got = rt.get(
-            g.actor.take_p2p.remote(key, min(remaining, 30.0)),
-            timeout=min(remaining, 30.0) + 30,
-        )
-        if got is not None:
-            return got["v"]
+    # ONE event-waited server-side park honoring the caller's full timeout
+    # (the old shape re-issued take_p2p in 30s slices and padded the
+    # enclosing rt.get by +30s — a missing sender cost timeout+30 to fail).
+    got = rt.get(
+        g.actor.take_p2p.remote(key, timeout),
+        timeout=timeout + 5.0,
+    )
+    if got is None:
+        raise TimeoutError(f"recv from {src_rank} timed out")
+    return got["v"]

@@ -145,6 +145,22 @@ class Config:
     # the fast path, this poll catches replicas whose subscription missed a
     # publish (controller restart, dropped conn).
     ckpt_poll_interval_s: float = 2.0
+    # --- collectives (ring transport + train-plane gradient sync) ---
+    # Gradient-bucket target size for the train plane's bucketed overlap
+    # (train/grad_sync.py): leaves pack into ~this many bytes per bucket and
+    # each bucket's ring allreduce launches as soon as the bucket fills.
+    collective_bucket_bytes: int = 4 * 1024 * 1024
+    # Raw-frame part size for one ring step's payload: chunks larger than
+    # this split into several keyed frames (bounds per-frame memory and
+    # keeps any single frame well under the transport's _MAX_FRAME cap).
+    collective_part_bytes: int = 8 * 1024 * 1024
+    # Per-step deadline on the ring: a lost/rejected frame surfaces as a
+    # typed CollectiveError within this bound (never a hang), and the abort
+    # fans around the ring so every blocked rank fails attributed.
+    collective_ring_step_timeout_s: float = 30.0
+    # Block size for int8 quantized allreduce (elements per fp32 absmax
+    # scale). 256 => 1.6% wire overhead for scales at 4x payload shrink.
+    collective_quant_block: int = 256
     # --- chaos (deterministic fault injection; see ray_tpu/chaos/) ---
     # JSON FaultSchedule spec ({"seed": N, "rules": [...]}) armed in EVERY
     # process of the session: the head pushes it with the rest of the config

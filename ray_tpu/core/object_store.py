@@ -164,6 +164,8 @@ class SharedMemoryClient:
     def create(self, oid: ObjectID, size: int) -> memoryview:
         """Allocate and return a writable view; call seal() when done."""
         with self._lock:
+            if self._h is None:
+                raise ObjectStoreFullError("store closed")
             off = self._lib.store_create_obj(self._h, oid.binary(), size)
         if off == -1:
             raise ObjectExistsError(oid.hex())
@@ -172,7 +174,11 @@ class SharedMemoryClient:
         return self._view[off : off + size]
 
     def seal(self, oid: ObjectID):
-        if self._lib.store_seal(self._h, oid.binary()) != 0:
+        with self._lock:
+            if self._h is None:
+                raise KeyError(f"seal: store closed ({oid.hex()})")
+            rc = self._lib.store_seal(self._h, oid.binary())
+        if rc != 0:
             raise KeyError(f"seal: {oid.hex()} not in created state")
 
     def abort(self, oid: ObjectID) -> bool:
@@ -234,7 +240,10 @@ class SharedMemoryClient:
         if not self.spill_dir:
             return []
         buf = ctypes.create_string_buffer(_ID_SIZE * max_ids)
-        n = self._lib.store_evict_candidates(self._h, nbytes, buf, max_ids)
+        with self._lock:
+            if self._h is None:
+                return []
+            n = self._lib.store_evict_candidates(self._h, nbytes, buf, max_ids)
         if n <= 0:
             return []
         os.makedirs(self.spill_dir, exist_ok=True)
@@ -311,6 +320,8 @@ class SharedMemoryClient:
         """Pinned zero-copy view, or None. Pair with release()."""
         size = ctypes.c_uint64()
         with self._lock:
+            if self._h is None:
+                return None
             off = self._lib.store_get(self._h, oid.binary(), ctypes.byref(size))
         if off < 0:
             return None
@@ -322,6 +333,8 @@ class SharedMemoryClient:
         which another arena client's eviction could reap it."""
         size = ctypes.c_uint64()
         with self._lock:
+            if self._h is None:
+                return None
             off = self._lib.store_seal_pinned(self._h, oid.binary(), ctypes.byref(size))
         if off < 0:
             return None
@@ -340,7 +353,13 @@ class SharedMemoryClient:
         return PinnedBuffer(view, self, oid)
 
     def release(self, oid: ObjectID):
-        self._lib.store_release(self._h, oid.binary())
+        # Locked like get(): close() nulls the handle under this lock, so a
+        # release racing shutdown no-ops instead of entering native code on
+        # a detached handle (callers run on arbitrary threads).
+        with self._lock:
+            if self._h is None:
+                return
+            self._lib.store_release(self._h, oid.binary())
 
     def get_copy(self, oid: ObjectID) -> Optional[bytes]:
         view = self.get(oid)
@@ -353,7 +372,10 @@ class SharedMemoryClient:
 
     # -- management -----------------------------------------------------
     def contains(self, oid: ObjectID) -> bool:
-        return bool(self._lib.store_contains(self._h, oid.binary()))
+        with self._lock:
+            if self._h is None:
+                return False
+            return bool(self._lib.store_contains(self._h, oid.binary()))
 
     def contains_or_spilled(self, oid: ObjectID) -> bool:
         return self.contains(oid) or self.is_spilled(oid)
@@ -363,10 +385,20 @@ class SharedMemoryClient:
         now or already gone), False ONLY while a pin defers the delete —
         the retry-loop contract (plain delete() conflates missing with
         pinned, which would retry tombstones forever)."""
-        return self._lib.store_delete(self._h, oid.binary()) != -2
+        with self._lock:
+            if self._h is None:
+                return True  # store closed: nothing exists anymore
+            return self._lib.store_delete(self._h, oid.binary()) != -2
 
     def delete(self, oid: ObjectID, drop_spilled: bool = False) -> bool:
-        ok = self._lib.store_delete(self._h, oid.binary()) == 0
+        # A delete_objects notify can still be dispatched on the daemon loop
+        # after stop() closed the store (the dispatch task was already
+        # queued): a native call on the detached handle is a segfault, not
+        # an error (observed as a ~1/3-flaky SIGSEGV in bench teardown).
+        with self._lock:
+            if self._h is None:
+                return False
+            ok = self._lib.store_delete(self._h, oid.binary()) == 0
         if drop_spilled and self.spill_dir:
             try:
                 os.unlink(os.path.join(self.spill_dir, oid.hex()))
@@ -377,7 +409,10 @@ class SharedMemoryClient:
 
     def evict(self, nbytes: int, max_ids: int = 4096) -> list[ObjectID]:
         buf = ctypes.create_string_buffer(_ID_SIZE * max_ids)
-        n = self._lib.store_evict(self._h, nbytes, buf, max_ids)
+        with self._lock:
+            if self._h is None:
+                return []
+            n = self._lib.store_evict(self._h, nbytes, buf, max_ids)
         return [ObjectID(buf.raw[i * _ID_SIZE : (i + 1) * _ID_SIZE]) for i in range(n)]
 
     def list_objects(self, max_ids: int = 65536) -> list[tuple[ObjectID, int]]:
@@ -385,7 +420,10 @@ class SharedMemoryClient:
         separately if needed."""
         ids = ctypes.create_string_buffer(_ID_SIZE * max_ids)
         sizes = (ctypes.c_uint64 * max_ids)()
-        n = self._lib.store_list(self._h, ids, sizes, max_ids)
+        with self._lock:
+            if self._h is None:
+                return []
+            n = self._lib.store_list(self._h, ids, sizes, max_ids)
         return [
             (ObjectID(ids.raw[i * _ID_SIZE : (i + 1) * _ID_SIZE]), int(sizes[i]))
             for i in range(n)
@@ -393,20 +431,25 @@ class SharedMemoryClient:
 
     @property
     def capacity(self) -> int:
-        return self._lib.store_capacity(self._h)
+        return 0 if self._h is None else self._lib.store_capacity(self._h)
 
     @property
     def used(self) -> int:
-        return self._lib.store_used(self._h)
+        return 0 if self._h is None else self._lib.store_used(self._h)
 
     @property
     def num_objects(self) -> int:
-        return self._lib.store_num_objects(self._h)
+        return 0 if self._h is None else self._lib.store_num_objects(self._h)
 
     def close(self):
-        if self._h:
-            self._lib.store_detach(self._h)
-            self._h = None
+        # Null the handle BEFORE detaching (under the read lock): any later
+        # call sees None and no-ops instead of entering native code on a
+        # dead handle/unmapped arena. The loser of two concurrent closes
+        # sees None after the locked swap and returns.
+        with self._lock:
+            h, self._h = self._h, None
+        if h:
+            self._lib.store_detach(h)
             try:
                 self._view.release()
                 self._mmap.close()

@@ -2607,6 +2607,32 @@ class CoreWorker:
 
         return dag_result(self, p)
 
+    # -- collective ring transport (ray_tpu/collective/ring.py) ----------
+    # The ring's control plane rides the worker RPC server: a neighbor's
+    # hello pins which inbound Connection carries its raw frames; ready/
+    # meta/abort notifies key per-op events. Raw tensor frames themselves
+    # never reach a handler — they land in expect_raw buffers.
+
+    def handle_collective_ring_hello(self, conn, p):
+        from ray_tpu.collective import ring as _colring
+
+        return _colring._on_hello(conn, p)
+
+    def handle_collective_ring_ready(self, conn, p):
+        from ray_tpu.collective import ring as _colring
+
+        _colring._on_ready(p)
+
+    def handle_collective_ring_meta(self, conn, p):
+        from ray_tpu.collective import ring as _colring
+
+        _colring._on_meta(p)
+
+    def handle_collective_ring_abort(self, conn, p):
+        from ray_tpu.collective import ring as _colring
+
+        return _colring._on_abort(p)
+
     def handle_shutdown(self, conn, p):
         self._shutdown = True
         if self._actor_runtime is not None:

@@ -7,13 +7,22 @@ report()/get_context()/get_checkpoint() from inside the train fn.
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
 from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from ray_tpu.train.controller import Result, TrainController
+
+# The grad_sync SUBMODULE import must precede the session import below:
+# initializing a submodule sets the package attribute ``train.grad_sync`` to
+# the module, and the session's ``grad_sync`` FUNCTION (the public
+# ``train.grad_sync(...)`` API) must win that name. The submodule stays
+# reachable via ``from ray_tpu.train.grad_sync import ...`` (sys.modules).
+from ray_tpu.train.grad_sync import BucketedGradSync, ShardedOptimizerStep
 from ray_tpu.train.session import (
     TrainContext,
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    grad_sync,
     report,
     save_pytree_async,
+    sharded_optimizer,
 )
 from ray_tpu.train.scaling_policy import (
     ElasticScalingPolicy,
@@ -26,6 +35,7 @@ from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
 
 __all__ = [
+    "BucketedGradSync",
     "Checkpoint",
     "CheckpointConfig",
     "CheckpointManager",
@@ -36,6 +46,7 @@ __all__ = [
     "NoopDecision",
     "ResizeDecision",
     "ScalingPolicy",
+    "ShardedOptimizerStep",
     "JaxTrainer",
     "Result",
     "RunConfig",
@@ -47,8 +58,10 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "grad_sync",
     "load_pytree",
     "report",
     "save_pytree",
     "save_pytree_async",
+    "sharded_optimizer",
 ]

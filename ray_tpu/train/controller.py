@@ -84,6 +84,10 @@ class TrainController:
         group: Optional[WorkerGroup] = None
         name = self.run_config.name or "train_run"
         max_failures = self.run_config.failure_config.max_failures
+        # World sizes this run started gangs at: each has its own collective
+        # coordinator (train:<name>:w<n>, see session.collective_group) to
+        # reap when the run ends — an elastic resize changes the size.
+        gang_sizes: set[int] = set()
         while True:
             try:
                 if group is None:
@@ -99,6 +103,7 @@ class TrainController:
                     self._metric_entries.clear()
                     self._max_metric_seq = -1
                     group = WorkerGroup(self.scaling, name, self.storage_path)
+                    gang_sizes.add(self.scaling.num_workers)
                     group.start()
                     resume = self.ckpt_manager.latest
                     group.run(
@@ -188,6 +193,16 @@ class TrainController:
 
         if group is not None:
             group.shutdown()
+        # Reap the run's collective coordinators (no-op when the train fn
+        # never called grad_sync()/sharded_optimizer(): destroying a group
+        # whose named actor doesn't exist returns immediately).
+        from ray_tpu import collective as col
+
+        for n in gang_sizes:
+            try:
+                col.destroy_collective_group(f"train:{name}:w{n}")
+            except Exception:
+                pass  # best-effort: the coordinator dies with the cluster anyway
         return Result(
             metrics=self.latest_metrics,
             checkpoint=self.ckpt_manager.latest,

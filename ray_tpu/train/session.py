@@ -38,6 +38,7 @@ class TrainSession:
         self.stop_event = threading.Event()
         self._report_seq = 0
         self._async_saver = None  # lazy ckpt-plane AsyncSaver (save_pytree_async)
+        self._collective_group: Optional[str] = None  # lazy gang group
 
     # -- user API ----------------------------------------------------------
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
@@ -132,6 +133,44 @@ class TrainSession:
         shutil.copytree(src, dest)
         return dest
 
+    def collective_group(self) -> str:
+        """Join (once, lazily) this run's host collective gang — group name
+        ``train:<experiment>:w<world>``, ranks = the session's world ranks —
+        and return the group name. The detached coordinator is reused by
+        name across same-size gang restarts (fresh epoch per full re-join);
+        the world size is part of the name because a coordinator's world
+        size is immutable — an elastic resize rendezvouses on a fresh
+        coordinator instead of failing the mismatch check forever. The
+        TrainController destroys the run's coordinators best-effort when
+        fit() returns; any stragglers are cluster-scoped detached actors,
+        gone with the cluster."""
+        if self._collective_group is None:
+            from ray_tpu import collective as col
+
+            name = f"train:{self.experiment_name}:w{self.world_size}"
+            col.init_collective_group(self.world_size, self.world_rank,
+                                      group_name=name)
+            self._collective_group = name
+        return self._collective_group
+
+    def grad_sync(self, **kwargs) -> "BucketedGradSync":
+        """The tentpole wiring: a BucketedGradSync bound to this run's gang
+        (compute/collective overlap — push() grads as backward produces
+        them; see train/grad_sync.py)."""
+        from ray_tpu.train.grad_sync import BucketedGradSync
+
+        return BucketedGradSync(self.collective_group(), **kwargs)
+
+    def sharded_optimizer(self, optimizer: str = "adam",
+                          **kwargs) -> "ShardedOptimizerStep":
+        """A ShardedOptimizerStep bound to this run's gang (reduce-scatter
+        grads, shard-sized optimizer state, allgather params)."""
+        from ray_tpu.train.grad_sync import ShardedOptimizerStep
+
+        return ShardedOptimizerStep(optimizer,
+                                    group_name=self.collective_group(),
+                                    **kwargs)
+
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.resume_checkpoint
 
@@ -180,6 +219,12 @@ class TrainContext:
     def get_dataset_shard(self, name: str = "train"):
         return self._s.get_dataset_shard(name)
 
+    def grad_sync(self, **kwargs):
+        return self._s.grad_sync(**kwargs)
+
+    def sharded_optimizer(self, optimizer: str = "adam", **kwargs):
+        return self._s.sharded_optimizer(optimizer, **kwargs)
+
 
 def _set_session(s: "TrainSession | None"):
     global _session
@@ -215,6 +260,23 @@ def get_dataset_shard(name: str = "train"):
     if s is None:
         raise RuntimeError("get_dataset_shard() called outside a train worker")
     return s.get_dataset_shard(name)
+
+
+def grad_sync(**kwargs):
+    """Module-level convenience: the current train session's gang-bound
+    BucketedGradSync (raises outside a train worker)."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.grad_sync() called outside a train worker")
+    return s.grad_sync(**kwargs)
+
+
+def sharded_optimizer(optimizer: str = "adam", **kwargs):
+    """Module-level convenience: a gang-bound ShardedOptimizerStep."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.sharded_optimizer() called outside a train worker")
+    return s.sharded_optimizer(optimizer, **kwargs)
 
 
 def save_pytree_async(tree, metrics: dict, mesh: Optional[dict] = None):

@@ -190,6 +190,22 @@ def test_autoscale_flap_scenario_smoke():
                for d in report["details"]["applied_decisions"])
 
 
+def test_ring_link_loss_scenario_smoke():
+    """The collective-plane acceptance scenario: ring frames dropped and
+    corrupted in flight — every rank fails with a typed CollectiveError
+    inside the step deadline (never a hang), the same gang completes a
+    clean round afterward, and the coordinator's payload-byte counter
+    stays at zero throughout."""
+    report = run_scenario("ring_link_loss", seed=9, quick=True)
+    assert report["ok"], report
+    rounds = report["details"]["rounds"]
+    assert [r["round"] for r in rounds] == ["drop", "corrupt", "clean"]
+    assert all(r["elapsed_s"] < 25 for r in rounds)
+    assert report["details"]["coordinator_stats"] == {
+        "payload_in": 0, "payload_out": 0}
+    assert report["invariants"]["faults_visible_in_metrics"]["ok"]
+
+
 def test_same_seed_replays_identical_injection_sequence():
     """The replay contract, asserted on two REAL runs: identical seed +
     schedule + workload => byte-identical normalized injection logs."""
