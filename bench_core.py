@@ -615,6 +615,100 @@ def bench_checkpoint_save_restore(n_bytes):
            detail=detail)
 
 
+class _ReshardParty:
+    """One host of the elastic-reshard bench: holds a deterministic slice
+    of the state, exports/pulls through ray_tpu.elastic.transfer."""
+
+    def export(self, tid, rank, world, rep_elems, win_elems):
+        from ray_tpu.core import api as _api
+        from ray_tpu.elastic import transfer
+
+        rep = {"params": np.arange(rep_elems, dtype=np.float32)}
+        shard = -(-win_elems // world)
+        lo = min(win_elems, rank * shard)
+        win = np.arange(lo, min(win_elems, lo + shard), dtype=np.float32)
+        meta = transfer.export_state(tid, rank, rep,
+                                     {"opt.0.m": (win, lo, win_elems)},
+                                     seq=1, meta={})
+        meta["addr"] = _api._require_worker().address
+        return meta
+
+    def pull(self, tid, sources, world, rank):
+        from ray_tpu.core import api as _api
+        from ray_tpu.elastic import transfer
+
+        core = _api._require_worker()
+        res = core._run(
+            transfer.pull_state(core, tid, sources, world, rank),
+            timeout=600)
+        return res["stats"]
+
+    def release(self, tid):
+        from ray_tpu.elastic import transfer
+
+        return transfer.release(tid)
+
+
+def bench_elastic_reshard(n_bytes):
+    """Elastic-plane A/B (ISSUE-13 acceptance): redistribute the same
+    2-host state onto a 1-host mesh (a) LIVE over the raw-frame lane
+    (multi-source pulls from two exporter workers into a third, zero
+    pickle, zero disk) vs (b) the checkpoint-restore control (ckpt-plane
+    sharded save once, rectangle-intersection restore per rep — the blob
+    round trip the live path replaces). Arms interleave per rep so host
+    drift hits both."""
+    import shutil
+    import tempfile
+
+    from ray_tpu import ckpt as _ckpt
+
+    rep_elems = max(1, n_bytes // 8)   # replicated params half
+    win_elems = max(1, n_bytes // 8)   # sharded window half
+    Party = rt.remote(_ReshardParty)
+    a, b, c = Party.remote(), Party.remote(), Party.remote()
+    root = tempfile.mkdtemp(prefix="raytpu_bench_reshard_")
+    saver = _ckpt.AsyncSaver(root, num_to_keep=2)
+    tree = {"params": np.arange(rep_elems, dtype=np.float32),
+            "opt.0.m": np.arange(win_elems, dtype=np.float32)}
+    saver.save(0, tree)  # the control's checkpoint exists BEFORE the resize
+    manifest = saver.manifests.latest
+    live_times, ctrl_times, live_stats = [], [], []
+    total_bytes = (rep_elems + win_elems) * 4
+    reps = 3
+    try:
+        for rep in range(reps):
+            # Arm A: live reshard into a fresh target.
+            tid = f"bench-{rep}"
+            metas = [rt.get(w.export.remote(tid, r, 2, rep_elems, win_elems),
+                            timeout=120) for r, w in ((0, a), (1, b))]
+            t0 = time.perf_counter()
+            stats = rt.get(c.pull.remote(tid, metas, 1, 0), timeout=600)
+            live_times.append(time.perf_counter() - t0)
+            live_stats.append(stats)
+            for w in (a, b):
+                rt.get(w.release.remote(tid), timeout=60)
+            # Arm B: checkpoint-restore control, same target layout.
+            t0 = time.perf_counter()
+            restored = _ckpt.restore(manifest, saver.chunks)
+            ctrl_times.append(time.perf_counter() - t0)
+            assert restored["params"].nbytes == rep_elems * 4
+        live = sorted(live_times)[len(live_times) // 2]
+        ctrl = sorted(ctrl_times)[len(ctrl_times) // 2]
+        st = live_stats[live_times.index(live)]
+        report(
+            "elastic_reshard_mb_s", total_bytes / 1e6, live, unit="MB/s",
+            detail={
+                "bytes": total_bytes,
+                "wire_bytes": st["wire_bytes"],
+                "failovers": st["failovers"],
+                "ckpt_restore_mb_s": round(total_bytes / 1e6 / ctrl, 1),
+                "live_vs_ckpt_restore_x": round(ctrl / live, 2),
+                "reps": reps,
+            })
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_allreduce_gbps(n_bytes):
     """Collective-plane A/B (ISSUE-12 acceptance): fp32 ring vs fp32
     coordinator vs int8 ring allreduce of one >= 1 MiB tensor across a
@@ -772,6 +866,7 @@ def main():
         (bench_put_gigabytes, int(512 * 1024 * 1024 * SCALE)),
         (bench_large_object_pull, int(64 * 1024 * 1024 * SCALE)),
         (bench_checkpoint_save_restore, int(64 * 1024 * 1024 * SCALE)),
+        (bench_elastic_reshard, int(32 * 1024 * 1024 * SCALE)),
         (bench_allreduce_gbps, 4 * 1024 * 1024),
         (bench_train_step_overlap, max(2, int(8 * SCALE))),
         (bench_wait_1k_refs, max(1, int(5 * SCALE))),

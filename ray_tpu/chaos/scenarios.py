@@ -901,8 +901,315 @@ def _scn_ring_link_loss(seed: int, quick: bool) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# elastic_preempt: the elastic train plane's acceptance scenario
+# ---------------------------------------------------------------------------
+
+# Module-level train fn (pickled to workers). Deterministic SPMD step:
+# identical per-step batches on every rank, adam via ShardedOptimizerStep
+# (per-rank m/v windows — the state a live reshard actually has to move).
+# Every step reports (loss, digest-of-full-state) and registers the state
+# both ways: keep_live() for the elastic plane AND a rank-0 full-state
+# checkpoint (optimizer windows allgathered first) for the control arm's
+# disk round-trip. DISK_READS counts every byte the resume path reads back
+# — the live arm's counting shim must stay at zero.
+_ELASTIC_D = 192  # params per run (2 buckets at the 1 KiB bucket cut)
+
+
+def _elastic_preempt_fn(config):
+    import hashlib as _hl
+
+    import numpy as np
+
+    import ray_tpu.train as train
+
+    ctx = train.get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    steps, barrier_step = config["steps"], config["barrier_step"]
+    start_world = config["start_world"]
+    opt = ctx.sharded_optimizer("adam", lr=0.05, bucket_bytes=1024)
+    disk_reads = 0
+
+    def batch(i):
+        return np.random.default_rng(1000 + i).normal(
+            size=_ELASTIC_D).astype(np.float32)
+
+    resumed = train.live_resume()
+    if resumed is not None:
+        params = np.array(resumed["state"]["params"], copy=True)
+        opt.adopt_shards(resumed["sharded"], t=resumed["meta"]["t"])
+        start = resumed["meta"]["step"] + 1
+        resume_kind = "live"
+    elif train.get_checkpoint() is not None:
+        with train.get_checkpoint().as_directory() as d:
+            blob = open(os.path.join(d, "full.npz"), "rb").read()
+            disk_reads += len(blob)
+            import io
+
+            data = np.load(io.BytesIO(blob), allow_pickle=False)
+            params = np.array(data["params"], copy=True)
+            t = int(data["t"])
+            start = int(data["step"]) + 1
+            # The disk round-trip reshard: restore FULL optimizer state,
+            # slice this rank's window under the NEW world size.
+            sharded = {}
+            for key in data.files:
+                if not key.startswith("opt."):
+                    continue
+                full = data[key]
+                n = full.size
+                shard = -(-n // world)
+                lo = min(n, rank * shard)
+                hi = min(n, lo + shard)
+                sharded[key] = (full[lo:hi], lo, n)
+            opt.adopt_shards(sharded, t=t)
+        resume_kind = "ckpt"
+    else:
+        params = np.zeros(_ELASTIC_D, dtype=np.float32)
+        start = 0
+        resume_kind = "fresh"
+
+    def digest(p, full):
+        h = _hl.blake2b(digest_size=12)
+        h.update(np.ascontiguousarray(p).tobytes())
+        for key in sorted(full):
+            h.update(key.encode())
+            h.update(np.ascontiguousarray(full[key]).tobytes())
+        return h.hexdigest()
+
+    if resumed is not None or resume_kind == "ckpt":
+        # Prove the resumed state is byte-identical to the parked boundary:
+        # reassemble the FULL optimizer state on the new mesh and digest it
+        # with the params — must equal the digest reported at the boundary.
+        train.report({"resume_digest": digest(params, opt.full_state()),
+                      "resume_kind": resume_kind, "resume_step": start - 1,
+                      "disk_reads": disk_reads, "world_size": world})
+
+    for i in range(start, steps):
+        grads = {"params": params - batch(i)}
+        params = opt.step({"params": params}, grads)["params"]
+        loss = float(0.5 * np.sum((params - batch(i)) ** 2))
+        full = opt.full_state()  # all ranks: collective allgather
+        if rank == 0:
+            d = tempfile.mkdtemp()
+            arrays = {"params": params, "t": np.int64(opt._t),
+                      "step": np.int64(i)}
+            arrays.update(full)
+            np.savez(os.path.join(d, "full.npz"), **arrays)
+            from ray_tpu.train import Checkpoint
+
+            train.report({"step": i, "loss": repr(loss),
+                          "digest": digest(params, full),
+                          "world_size": world, "disk_reads": disk_reads},
+                         checkpoint=Checkpoint.from_directory(d))
+        else:
+            train.report({"step": i, "loss": repr(loss),
+                          "digest": digest(params, full),
+                          "world_size": world, "disk_reads": disk_reads})
+        train.keep_live({"params": params},
+                        sharded=opt.live_shards(),
+                        meta={"step": i, "t": opt._t})
+        marker = config.get("marker")
+        if marker and i >= 1 and rank == 0:
+            open(marker, "w").close()
+        if i == barrier_step and world == start_world:
+            # Deterministic resize point: park at this boundary until the
+            # controller stops the gang (live reshard) or the preempted
+            # host dies (control arm's failure restart). Without this the
+            # ranks could stop at different boundaries and the reshard
+            # would (correctly) refuse the inconsistent cut.
+            while not ctx.should_stop():
+                time.sleep(0.05)
+            raise RuntimeError("stopped at resize barrier")
+
+
+def _run_elastic_arm(seed: int, live: bool, steps: int, tmp: str) -> dict:
+    """One arm of the A/B: a 3-worker gang on 3 single-CPU hosts, TPU
+    preemption notice on worker_id=1 mid-run, resume at world 2. Returns
+    the arm's per-step records + controller stats; leaves NOTHING running
+    (its cluster is torn down here) — except the live arm, whose cluster
+    stays up for the invariant battery."""
+    import ray_tpu as rt
+    from ray_tpu.accel.tpu import TPU_SLICE_NAME_LABEL, TPU_WORKER_ID_LABEL
+    from ray_tpu.core.api import Cluster, init
+    from ray_tpu.train import (
+        ElasticScalingPolicy,
+        FailureConfig,
+        RunConfig,
+        ScalingConfig,
+        TrainController,
+    )
+
+    cfg = _fresh_config()
+    cfg.heartbeat_interval_s = 0.2
+    cfg.elastic_transfer_timeout_s = 10.0
+    rules = [{"site": "tpu.preempt", "kind": "preempt", "nth": 1,
+              "delay_s": 6.0, "ctx": {"worker_id": "1"}}]
+    if live:
+        # Exercise the transfer site under the same seed: a small injected
+        # delay on every 3rd reshard frame (byte-identity must survive it).
+        rules.append({"site": "elastic.reshard.transfer", "kind": "delay",
+                      "delay_s": 0.02, "every": 3})
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=0)  # head: driver only, no gang capacity
+    for wid in range(3):
+        cluster.add_node(
+            num_cpus=1,
+            labels={TPU_SLICE_NAME_LABEL: "slice-a",
+                    TPU_WORKER_ID_LABEL: str(wid)},
+        )
+    init(address=cluster.address, config=cfg)
+    marker = os.path.join(tmp, f"progress-{'live' if live else 'ctrl'}")
+    scaling = ScalingConfig(num_workers=3, resources_per_worker={"CPU": 1})
+    controller = TrainController(
+        _elastic_preempt_fn,
+        {"steps": steps, "barrier_step": 3, "start_world": 3,
+         "marker": marker},
+        scaling,
+        RunConfig(
+            name=f"elastic-{'live' if live else 'ctrl'}",
+            storage_path=os.path.join(tmp, "live" if live else "ctrl"),
+            failure_config=FailureConfig(max_failures=2),
+            elastic_live=live,
+        ),
+        settle_period_s=3.0,
+        scaling_policy=ElasticScalingPolicy(
+            scaling, min_workers=2, max_workers=3,
+            resize_cooldown_s=3600.0,  # growth disabled: shrink-only arm
+        ),
+    )
+
+    import threading
+
+    def arm_when_progressing():
+        deadline = time.time() + 120
+        while not os.path.exists(marker) and time.time() < deadline:
+            time.sleep(0.1)
+        # Mid-epoch, deterministically: ranks are at/behind the barrier
+        # step (they park there), the victim's next heartbeat (0.2s) gets
+        # the preemption notice, and the grace window (6s) covers the
+        # live transfer.
+        _plan.install(_plan.FaultSchedule.from_spec(
+            {"seed": seed, "rules": rules}))
+
+    t = threading.Thread(target=arm_when_progressing, daemon=True)
+    t.start()
+    result = controller.run()
+    t.join()
+    _require(result.error is None,
+             f"{'live' if live else 'control'} arm failed: {result.error}")
+    out = {
+        "metrics": result.metrics_history,
+        "state": controller.get_state(),
+        "reshard": getattr(controller, "last_live_resize", None),
+    }
+    if not live:
+        # Control cluster makes way for the live arm (same process).
+        from ray_tpu.core import api
+
+        api.shutdown()
+        cluster.shutdown()
+        _ACTIVE["cluster"] = None
+        _plan.uninstall()
+    return out
+
+
+def _scn_elastic_preempt(seed: int, quick: bool) -> dict:
+    """TPU preemption mid-epoch under a seeded schedule, resolved two ways
+    on identical 3->2 runs: (A) checkpoint-restore control — the classic
+    blob-store round trip; (B) the elastic plane's live reshard — optimizer
+    windows and params move host-to-host over the raw lane during the drain
+    grace window. Invariants pinned:
+
+    * byte-identical loss trajectory: every (step -> loss, state-digest)
+      record agrees across the arms, including the resumed boundary digest;
+    * the live arm's counting shim proves ZERO disk/blob reads on its
+      resume path, while the control arm's restore reads > 0;
+    * redistribution throughput is reported (wire bytes > 0, MB/s > 0) and
+      the gang coordinator re-keyed (train:<exp>:w3 -> w2).
+    """
+    steps = 7 if quick else 10
+    tmp = tempfile.mkdtemp(prefix="elastic_preempt_")
+    ctrl = _run_elastic_arm(seed, live=False, steps=steps, tmp=tmp)
+    live = _run_elastic_arm(seed, live=True, steps=steps, tmp=tmp)
+
+    def fold(arm):
+        by_step: dict = {}
+        resume = None
+        for m in arm["metrics"]:
+            if "resume_digest" in m:
+                resume = m
+            elif "step" in m:
+                # Later reports of the same step (absorbed across a restart)
+                # must agree with the earlier ones.
+                prev = by_step.get(m["step"])
+                if prev is not None:
+                    _require(
+                        (prev["loss"], prev["digest"]) == (m["loss"], m["digest"]),
+                        f"step {m['step']} disagrees with its own replay: "
+                        f"{prev} vs {m}")
+                by_step[m["step"]] = m
+        return by_step, resume
+
+    c_steps, c_resume = fold(ctrl)
+    l_steps, l_resume = fold(live)
+    _require(set(c_steps) == set(l_steps) == set(range(steps)),
+             f"step coverage differs: ctrl={sorted(c_steps)} live={sorted(l_steps)}")
+    for i in range(steps):
+        _require(
+            (c_steps[i]["loss"], c_steps[i]["digest"])
+            == (l_steps[i]["loss"], l_steps[i]["digest"]),
+            f"trajectory diverged at step {i}: control "
+            f"{c_steps[i]['loss']}/{c_steps[i]['digest']} vs live "
+            f"{l_steps[i]['loss']}/{l_steps[i]['digest']}")
+    # Both arms really resized 3 -> 2 at the barrier.
+    for name, st in (("control", c_steps), ("live", l_steps)):
+        sizes = [st[i]["world_size"] for i in range(steps)]
+        _require(sizes[0] == 3 and sizes[-1] == 2, f"{name} sizes: {sizes}")
+    # Both arms resumed from the SAME boundary, byte-identically.
+    for name, (resume, st) in (("control", (c_resume, c_steps)),
+                               ("live", (l_resume, l_steps))):
+        _require(resume is not None, f"{name} arm never reported its resume")
+        bstep = resume["resume_step"]
+        _require(resume["resume_digest"] == st[bstep]["digest"],
+                 f"{name} resumed state != step-{bstep} state")
+    _require(c_resume["resume_kind"] == "ckpt" and l_resume["resume_kind"] == "live",
+             f"wrong resume paths: {c_resume['resume_kind']}/{l_resume['resume_kind']}")
+    # Counting shims: zero disk reads on the live reshard path; the control
+    # round trip read its full state back.
+    live_reads = max(m.get("disk_reads", 0) for m in l_steps.values())
+    ctrl_reads = c_resume["disk_reads"]
+    _require(live_reads == 0, f"live arm read {live_reads} checkpoint bytes")
+    _require(ctrl_reads > 0, "control arm resumed without reading its checkpoint")
+    # Redistribution really moved bytes over the wire, and is reported.
+    reshard = live["reshard"]
+    _require(reshard is not None, "live arm recorded no reshard stats")
+    _require(reshard["wire_bytes"] > 0 and reshard["mb_s"] > 0,
+             f"no wire redistribution: {reshard}")
+    _require(live["state"]["live_resizes"] == 1 and live["state"]["resize_epoch"] >= 1,
+             f"live resize bookkeeping wrong: {live['state']}")
+    _require(ctrl["state"]["live_resizes"] == 0, "control arm live-resized")
+    return {
+        "cluster": _ACTIVE["cluster"],
+        "details": {
+            "steps": steps,
+            "reshard_mb_s": round(reshard["mb_s"], 2),
+            "reshard_wire_bytes": reshard["wire_bytes"],
+            "control_restore_bytes": ctrl_reads,
+            "final_loss": l_steps[steps - 1]["loss"],
+        },
+        # The injection log resets when the live arm installs its schedule
+        # (install() starts a fresh replayable log), so the floor counts
+        # only the live arm: its tpu.preempt, plus any transfer delays
+        # (site elastic.reshard.transfer, every=3).
+        "min_injections": 1,
+        "min_metric_injections": 1,
+    }
+
+
 SCENARIOS: dict = {
     "worker_kill": _scn_worker_kill,
+    "elastic_preempt": _scn_elastic_preempt,
     "pull_source_death": _scn_pull_source_death,
     "controller_restart": _scn_controller_restart,
     "mac_corrupt_storm": _scn_mac_corrupt_storm,

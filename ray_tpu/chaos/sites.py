@@ -134,6 +134,19 @@ SITES: dict = {
                      "a replica is slow to arrive — scenario autoscale_flap), "
                      "reconcile retry of failed starts",
     },
+    # -- L5.5: elastic train plane ----------------------------------------
+    "elastic.reshard.transfer": {
+        "layer": "elastic",
+        "kinds": {"drop", "delay", "error"},
+        "desc": "one live-reshard raw frame about to ship from a parked "
+                "export to a pulling rank (drop: never reaches the wire; "
+                "error: the fetch RPC fails typed; delay: slow source)",
+        "exercises": "receiver part-deadline -> typed ElasticTransferError, "
+                     "failed source's runs re-planned onto alternate "
+                     "replicas (multi-source failover); an uncoverable "
+                     "window falls back to the checkpoint-restore restart "
+                     "(scenario elastic_preempt)",
+    },
     # -- L5: checkpoint & weight-publication plane ------------------------
     "ckpt.chunk.write": {
         "layer": "ckpt",

@@ -8,10 +8,10 @@ through the source shard's chunk list (prefix sums), and ``pread``s only
 those byte ranges. Same-mesh restore is the degenerate case — one
 full-cover overlap per shard, whole-chunk reads.
 
-The span math is exact, not heuristic: a run is contiguous in the source
-buffer iff every dim right of its leading partial dim is fully covered in
-BOTH rectangles, so runs are as long as the layouts allow and never split
-a copy that could be one ``memcpy``.
+The rectangle/span geometry lives in ``ray_tpu/elastic/plan.py`` — the
+SAME math redistributes live arrays host-to-host in the elastic train
+plane; this module is the disk-facing consumer (runs mapped through chunk
+lists instead of peer connections).
 """
 from __future__ import annotations
 
@@ -23,78 +23,17 @@ import numpy as np
 
 from ray_tpu.ckpt.chunks import ChunkCorruption, ChunkStore
 from ray_tpu.ckpt.manifest import Manifest
+# Shared geometry (both planes import the one implementation; the names are
+# re-exported here because overlap_spans predates the elastic plane and
+# existing callers/tests reach it via ckpt.restore).
+from ray_tpu.elastic.plan import norm_index as _norm_index
+from ray_tpu.elastic.plan import overlap_spans
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
 
 _restore_mbs = _metrics.Gauge("ckpt.restore.mb_s", "last checkpoint restore throughput (MB/s)")
 _restore_bytes = _metrics.Counter(
     "ckpt.restore.bytes_total", "bytes assembled into restored arrays")
-
-
-def _norm_index(index, shape) -> list[tuple[int, int]]:
-    """Manifest/json index ([[start, stop], ...]) to tuples. An empty index
-    means "the whole array"; a scalar array gets one 1-element dim so the
-    span math is rank-uniform."""
-    if not index:
-        return [(0, int(d)) for d in shape] if shape else [(0, 1)]
-    return [(int(a), int(b)) for a, b in index]
-
-
-def _strides(extents: list[int]) -> list[int]:
-    out = [1] * len(extents)
-    for i in range(len(extents) - 2, -1, -1):
-        out[i] = out[i + 1] * extents[i + 1]
-    return out
-
-
-def overlap_spans(src_index, dst_index, itemsize: int, shape=None):
-    """Yield (src_byte_off, dst_byte_off, nbytes) runs copying the overlap
-    of two index rectangles between their row-major region buffers."""
-    src = _norm_index(src_index, shape)
-    dst = _norm_index(dst_index, shape)
-    over = [(max(s0, d0), min(s1, d1)) for (s0, s1), (d0, d1) in zip(src, dst)]
-    if any(a >= b for a, b in over):
-        return
-    src_ext = [s1 - s0 for s0, s1 in src]
-    dst_ext = [d1 - d0 for d0, d1 in dst]
-    over_ext = [b - a for a, b in over]
-    rank = len(over)
-    # k = leading edge of the fully-covered suffix (full in BOTH regions).
-    k = rank
-    while k > 0 and over_ext[k - 1] == src_ext[k - 1] == dst_ext[k - 1]:
-        k -= 1
-    src_strides = _strides(src_ext)
-    dst_strides = _strides(dst_ext)
-    suffix = 1
-    for j in range(k, rank):
-        suffix *= over_ext[j]
-    if k == 0:
-        run = suffix * itemsize
-        yield 0, 0, run
-        return
-    # Each emitted run covers dim k-1's overlap extent times the full
-    # suffix; the outer dims' overlap coordinates are iterated one by one.
-    run_elems = over_ext[k - 1] * suffix
-    outer = over[:k - 1]
-    counters = [a for a, _b in outer]
-    while True:
-        src_off = sum((c - s0) * st for c, (s0, _s1), st
-                      in zip(counters, src[:k - 1], src_strides[:k - 1]))
-        src_off += (over[k - 1][0] - src[k - 1][0]) * src_strides[k - 1]
-        dst_off = sum((c - d0) * st for c, (d0, _d1), st
-                      in zip(counters, dst[:k - 1], dst_strides[:k - 1]))
-        dst_off += (over[k - 1][0] - dst[k - 1][0]) * dst_strides[k - 1]
-        yield src_off * itemsize, dst_off * itemsize, run_elems * itemsize
-        # odometer over the outer overlap rectangle
-        i = len(outer) - 1
-        while i >= 0:
-            counters[i] += 1
-            if counters[i] < outer[i][1]:
-                break
-            counters[i] = outer[i][0]
-            i -= 1
-        if i < 0:
-            return
 
 
 def _chunk_offsets(shard: dict) -> list[int]:

@@ -161,6 +161,13 @@ class Config:
     # Block size for int8 quantized allreduce (elements per fp32 absmax
     # scale). 256 => 1.6% wire overhead for scales at 4x payload shrink.
     collective_quant_block: int = 256
+    # --- elastic train plane (live N->M reshard, ray_tpu/elastic/) ---
+    # Raw-frame part size for one reshard run's payload (same role as
+    # collective_part_bytes on the ring lane).
+    elastic_part_bytes: int = 4 * 1024 * 1024
+    # Per-source deadline for a live-reshard pull: a dead/stalled source
+    # fails typed within this bound and its runs re-plan onto alternates.
+    elastic_transfer_timeout_s: float = 30.0
     # --- chaos (deterministic fault injection; see ray_tpu/chaos/) ---
     # JSON FaultSchedule spec ({"seed": N, "rules": [...]}) armed in EVERY
     # process of the session: the head pushes it with the rest of the config

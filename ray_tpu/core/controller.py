@@ -166,6 +166,11 @@ class Controller:
         # resource footprints here; the autoscaler treats them exactly like
         # pending task/actor demand. source -> [{"demand", "label_selector"}].
         self.external_demand: dict[str, list] = {}
+        # Elastic train plane: per-experiment live-resize epochs. Every
+        # resize attempt fences on a bump here, so a stale controller
+        # incarnation can never race a newer one's in-flight transfer.
+        self.elastic_epochs: dict[str, int] = {}
+        self.elastic_epochs_evicted = 0
         self.subscribers: dict[str, set] = {}  # channel -> conns
         self.jobs: dict[str, dict] = {}
         self._job_counter = 0
@@ -948,6 +953,29 @@ class Controller:
         ]
         return {"nodes": list(await asyncio.gather(*(one(n) for n in live)))}
 
+    # -- elastic train plane (live resize epoch fence) -------------------
+    def handle_elastic_resize_epoch(self, conn, p):
+        """Fence + bump one experiment's live-resize epoch. ``expect``
+        (optional) must match the current epoch or the bump is refused —
+        the caller is a stale controller incarnation and must fall back
+        rather than race the transfer that advanced the epoch."""
+        exp = p.get("experiment") or ""
+        if not exp:
+            return {"ok": False, "error": "experiment required"}
+        cur = self.elastic_epochs.get(exp, 0)
+        expect = p.get("expect")
+        if expect is not None and int(expect) != cur:
+            return {"ok": False, "epoch": cur, "error": "stale epoch"}
+        # Insertion-order refresh + LRU cap: active experiments stay, long-
+        # dead ones age out (counted, never silent).
+        self.elastic_epochs.pop(exp, None)
+        self.elastic_epochs[exp] = cur + 1
+        while len(self.elastic_epochs) > 512:
+            self.elastic_epochs.pop(next(iter(self.elastic_epochs)))
+            self.elastic_epochs_evicted += 1
+        self._event("elastic_resize", experiment=exp, epoch=cur + 1)
+        return {"ok": True, "epoch": cur + 1}
+
     # -- checkpoint registry & weight publication (ckpt plane) -----------
     def handle_ckpt_register(self, conn, p):
         """Record one save attempt's outcome. Committed summaries carrying a
@@ -1582,12 +1610,31 @@ class Controller:
             return await fut
         return {"state": pg.state}
 
+    def _release_pg_holdings(self, pg: PGRecord) -> None:
+        """Return every placed bundle's node-level reservation and mark the
+        bundles unplaced. Bundles on DEAD nodes have nothing to return; a
+        never-placed bundle (empty node_id) is a no-op. This is THE one
+        ledger-release for PG bundles: reschedule-after-node-death re-plans
+        from scratch (a commit would otherwise double-subtract the kept
+        nodes), and removal must refund survivors no matter what state the
+        PG died in (a RESCHEDULING/PENDING gang that still held two of its
+        three bundles used to leak them forever — the preempted-gang
+        restart then found its own CPUs permanently occupied)."""
+        for b in pg.bundles:
+            if b.node_id:
+                node = self.nodes.get(b.node_id)
+                if node and node.state == "ALIVE":
+                    _add(node.resources_available, b.resources)
+                b.node_id = ""
+                b.available = {}
+
     async def _schedule_pg(self, pg: PGRecord):
         """Gang-reserve all bundles atomically on the central ledger
         (reference: GcsPlacementGroupScheduler 2PC across raylets,
         bundle_scheduling_policy.h:73-97 for PACK/SPREAD/STRICT_*). An
         unplaceable PG stays PENDING; _retry_pending commits it when capacity
         appears (event-driven, no poll loop)."""
+        self._release_pg_holdings(pg)  # reschedule: free survivors first
         assignment = self._plan_bundles(pg)
         if assignment is None:
             pg.state = "PENDING"
@@ -1670,11 +1717,7 @@ class Controller:
         return True
 
     async def _remove_pg(self, pg: PGRecord):
-        if pg.state == "CREATED":
-            for b in pg.bundles:
-                node = self.nodes.get(b.node_id)
-                if node and node.state == "ALIVE":
-                    _add(node.resources_available, b.resources)
+        self._release_pg_holdings(pg)
         pg.state = "REMOVED"
         self.pgs.pop(pg.pg_id, None)
         for fut in pg.pending_waiters:

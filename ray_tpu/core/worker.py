@@ -2633,6 +2633,16 @@ class CoreWorker:
 
         return _colring._on_abort(p)
 
+    # -- elastic train plane (ray_tpu/elastic/transfer.py) ---------------
+    # Live-reshard byte runs ride the same raw lane as object pulls: this
+    # handler only slices parked export views and send_raw's them — the
+    # payload is never pickled and the reply carries only counters.
+
+    async def handle_elastic_fetch(self, conn, p):
+        from ray_tpu.elastic import transfer as _elastic
+
+        return await _elastic.fetch(self, conn, p)
+
     def handle_shutdown(self, conn, p):
         self._shutdown = True
         if self._actor_runtime is not None:

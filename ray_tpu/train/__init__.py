@@ -20,6 +20,8 @@ from ray_tpu.train.session import (
     get_context,
     get_dataset_shard,
     grad_sync,
+    keep_live,
+    live_resume,
     report,
     save_pytree_async,
     sharded_optimizer,
@@ -59,6 +61,8 @@ __all__ = [
     "get_context",
     "get_dataset_shard",
     "grad_sync",
+    "keep_live",
+    "live_resume",
     "load_pytree",
     "report",
     "save_pytree",

@@ -59,6 +59,13 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    # Live N->M mesh resharding (ray_tpu/elastic/): on a resize decision or
+    # a TPU preemption notice the gang's state moves host-to-host over the
+    # raw RPC lane and training resumes on the new mesh — no blob-store
+    # round trip. Requires the train fn to register state via
+    # train.keep_live(); falls back to the checkpoint-restore restart when
+    # no live state is registered or the transfer cannot cover the targets.
+    elastic_live: bool = False
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.join(

@@ -68,6 +68,13 @@ class TrainController:
         self.state = "INIT"
         self.failures = 0
         self.resizes = 0
+        self.live_resizes = 0
+        self.last_live_resize: Optional[dict] = None  # stats of the newest one
+        # Elastic-live bookkeeping: last fenced resize epoch (0 = never
+        # resized; the first bump passes expect=None) + preemption-probe
+        # rate limit (one cluster-state RPC per second, not per poll).
+        self._resize_epoch = 0
+        self._last_preempt_probe = 0.0
         self.metrics_history: list[dict] = []
         self.latest_metrics: dict = {}
         # Seqs absorbed from the CURRENT gang (reset per restart: a restarted
@@ -102,7 +109,13 @@ class TrainController:
                     self._seen_ckpt_seqs.clear()
                     self._metric_entries.clear()
                     self._max_metric_seq = -1
-                    group = WorkerGroup(self.scaling, name, self.storage_path)
+                    group = WorkerGroup(
+                        self.scaling, name, self.storage_path,
+                        # Elastic-live gangs schedule by plain resources: a
+                        # live resize keeps surviving actors and changes
+                        # membership, which a fixed-bundle PG can't express.
+                        gang_pg=not self.run_config.elastic_live,
+                    )
                     gang_sizes.add(self.scaling.num_workers)
                     group.start()
                     resume = self.ckpt_manager.latest
@@ -163,18 +176,26 @@ class TrainController:
             if all(s["finished"] for s in status):
                 self.state = "DONE"
                 break
-            decision = self.scaling_policy.make_decision_for_running_worker_group(status)
+            decision = None
+            if self.run_config.elastic_live:
+                # Preemption notice beats the scaling policy: a draining
+                # host's state must move DURING the grace window.
+                decision = self._preempt_decision(group)
+            if decision is None:
+                decision = self.scaling_policy.make_decision_for_running_worker_group(status)
             if (
                 getattr(decision, "num_workers", None) is not None
                 and decision.num_workers != len(group.workers)
             ):
                 # Elastic resize (reference: _execute_resize_decision,
                 # controller.py:183): graceful-stop the gang so every rank's
-                # final report/checkpoint is absorbed, rebuild at the new
-                # size, resume from the latest checkpoint with the new mesh.
+                # final report/checkpoint is absorbed, then EITHER reshard
+                # the live state in place (elastic_live) or rebuild at the
+                # new size from the latest checkpoint.
                 # NOT a failure: does not consume the failure budget.
                 self.state = "RESIZING"
                 self.resizes += 1
+                old_n = len(group.workers)
                 group.stop_all()
                 deadline = time.monotonic() + self.settle_period_s
                 while time.monotonic() < deadline:
@@ -186,6 +207,11 @@ class TrainController:
                     except Exception:
                         break
                     time.sleep(self.poll_interval_s)
+                if self.run_config.elastic_live:
+                    if self._live_resize(group, decision.num_workers, name, old_n):
+                        gang_sizes.add(self.scaling.num_workers)
+                        self.state = "RUNNING"
+                        continue
                 group.shutdown()
                 group = None
                 continue
@@ -210,6 +236,64 @@ class TrainController:
             error=error,
             metrics_history=self.metrics_history,
         )
+
+    def _preempt_decision(self, group):
+        """Map draining/dead gang nodes (the TPU preemption notice surface)
+        onto a shrink decision. Rate-limited: one cluster-state RPC per
+        second, not one per 5Hz poll."""
+        from ray_tpu.train.scaling_policy import ResizeDecision
+
+        now = time.monotonic()
+        if now - self._last_preempt_probe < 1.0:
+            return None
+        self._last_preempt_probe = now
+        try:
+            from ray_tpu.elastic import resize as _er
+
+            dying = _er.preempted_members(group)
+        except Exception:
+            return None
+        if not dying:
+            return None
+        min_w = max(1, int(getattr(self.scaling_policy, "min_workers", 1)))
+        target = max(min_w, len(group.workers) - len(dying))
+        if target == len(group.workers):
+            return None  # can't shrink below min: the failure path covers it
+        return ResizeDecision(
+            target, f"preemption notice: {len(dying)} member(s) draining")
+
+    def _live_resize(self, group, new_n: int, name: str, old_n: int) -> bool:
+        """Attempt the in-place reshard; on success the SAME group object
+        runs the fn at the new world size (seq bookkeeping resets like a
+        restart — the resumed fn re-reports from seq 1)."""
+        from ray_tpu.elastic import resize as _er
+
+        try:
+            stats = _er.live_resize(
+                group, new_n, experiment=name,
+                train_fn=self.train_fn, config=self.train_config,
+                datasets=self.datasets,
+                epoch_expect=self._resize_epoch or None)
+        except Exception:
+            traceback.print_exc()
+            stats = None
+        if stats is None:
+            return False
+        self._resize_epoch = stats["epoch"]
+        self.live_resizes += 1
+        self.last_live_resize = stats
+        self.scaling = dataclasses.replace(self.scaling, num_workers=new_n)
+        self._seen_ckpt_seqs.clear()
+        self._metric_entries.clear()
+        self._max_metric_seq = -1
+        # Preemption shrink: advertise the lost footprint so the node
+        # autoscaler replaces the capacity; a grow clears it.
+        try:
+            _er.set_lost_capacity_demand(
+                name, self.scaling.worker_resources(), max(0, old_n - new_n))
+        except Exception:
+            pass
+        return True
 
     def _drop_staged(self, path: str) -> None:
         """Remove a duplicate checkpoint dir — but ONLY if it is a staging
@@ -278,6 +362,8 @@ class TrainController:
             "state": self.state,
             "failures": self.failures,
             "resizes": self.resizes,
+            "live_resizes": self.live_resizes,
+            "resize_epoch": self._resize_epoch,
             "world_size": self.scaling.num_workers,
             "reported": len(self.metrics_history),
             "latest_metrics": self.latest_metrics,

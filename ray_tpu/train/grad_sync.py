@@ -190,6 +190,7 @@ class ShardedOptimizerStep:
         self.quantization = quantization
         self.timeout = timeout
         self._state: dict = {}   # bucket index -> {slot: shard array}
+        self._bucket_n: dict = {}  # bucket index -> true (unpadded) flat size
         self._t = 0              # adam step count
         self.peak_state_bytes = 0
 
@@ -198,6 +199,75 @@ class ShardedOptimizerStep:
         sharded-update invariant: ~slots * ceil(n/W) * 4, never slots * n * 4)."""
         return sum(a.nbytes for slots in self._state.values()
                    for a in slots.values())
+
+    # -- elastic plane: window export / adopt ------------------------------
+    # The per-rank slot arrays are windows [r*shard, (r+1)*shard) of a
+    # logical length-n flat per bucket (n tracked unpadded; pad elements are
+    # exactly zero — a zero grad keeps m=v=mom=0 — so they never ship).
+    # A live N->M reshard moves these windows through the SAME rectangle
+    # intersection the ckpt plane uses, then adopt_shards() re-pads.
+
+    def live_shards(self) -> dict:
+        """{path: (window_1d, lo, n_total)} for train.keep_live(sharded=...):
+        this rank's optimizer windows, clipped to each bucket's true size."""
+        from ray_tpu import collective as col
+
+        rank = col.get_rank(self.group_name)
+        out: dict = {}
+        for bi, slots in self._state.items():
+            n = self._bucket_n.get(bi)
+            if n is None:
+                continue  # never stepped: nothing to ship
+            for slot, arr in slots.items():
+                shard = arr.size
+                lo = rank * shard
+                keep = max(0, min(shard, n - lo))
+                out[f"opt.{bi}.{slot}"] = (arr[:keep], lo, n)
+        return out
+
+    def adopt_shards(self, sharded: dict, *, t: int) -> None:
+        """Rebuild this rank's slot windows from a live reshard's payload
+        ({path: (window_1d, lo, n_total)} — the keys live_shards() emitted,
+        windows already resharded to THIS rank's [lo, hi) under the new
+        world size). Re-pads each window to its ceil(n/W) allocation and
+        restores the adam step count."""
+        from ray_tpu import collective as col
+
+        self._t = int(t)
+        world = col.get_collective_group_size(self.group_name)
+        for path, (arr, lo, n) in sharded.items():
+            parts = path.split(".")
+            if len(parts) != 3 or parts[0] != "opt":
+                raise ValueError(f"unrecognized optimizer shard path {path!r}")
+            bi, slot = int(parts[1]), parts[2]
+            n = int(n)
+            self._bucket_n[bi] = n
+            slots = self._state.setdefault(bi, {})
+            # Uniform ceil(n/W) allocation under the NEW world size (adopt
+            # runs after the resized session re-joined its gang); tail/empty
+            # windows re-pad with exact zeros.
+            shard = -(-n // world) if world > 0 else n
+            padded = np.zeros(shard, dtype=arr.dtype)
+            padded[:arr.size] = arr
+            slots[slot] = padded
+        self.peak_state_bytes = max(self.peak_state_bytes, self.state_bytes())
+
+    def full_state(self) -> dict:
+        """{path: full length-n 1-D array} — every bucket slot allgathered
+        across the gang (the checkpoint-control path: a rank that persists
+        full optimizer state must first collect the other ranks' windows)."""
+        from ray_tpu import collective as col
+
+        out: dict = {}
+        for bi, slots in sorted(self._state.items()):
+            n = self._bucket_n.get(bi)
+            if n is None:
+                continue
+            for slot, arr in sorted(slots.items()):
+                full = np.concatenate(col.allgather(
+                    arr, self.group_name, timeout=self.timeout))[:n]
+                out[f"opt.{bi}.{slot}"] = full
+        return out
 
     def _buckets(self, leaves: list) -> list:
         """Deterministic bucketing by size+dtype boundary (same cuts on
@@ -267,6 +337,7 @@ class ShardedOptimizerStep:
             flat = np.concatenate([g_arrs[i].reshape(-1) for i in idxs])
             _bucket_hist.observe(float(flat.nbytes), tags={"mode": "sharded"})
             n = flat.size
+            self._bucket_n[bi] = n  # true size (elastic window export/adopt)
             shard = -(-n // world)  # ceil
             if shard * world != n:
                 flat = np.concatenate(

@@ -26,7 +26,8 @@ class TrainSession:
     def __init__(self, world_rank: int, world_size: int, local_rank: int,
                  experiment_name: str, storage_path: str,
                  resume_checkpoint: Optional[Checkpoint] = None,
-                 dataset_shards: Optional[dict] = None):
+                 dataset_shards: Optional[dict] = None,
+                 resume_live: Optional[dict] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -39,6 +40,12 @@ class TrainSession:
         self._report_seq = 0
         self._async_saver = None  # lazy ckpt-plane AsyncSaver (save_pytree_async)
         self._collective_group: Optional[str] = None  # lazy gang group
+        # Elastic plane: the payload a live N->M reshard delivered for THIS
+        # rank (train.live_resume()), and the state the train fn registers
+        # each step for the next reshard to ship (train.keep_live()).
+        self.resume_live = resume_live
+        self._live_lock = threading.Lock()
+        self._live: Optional[dict] = None
 
     # -- user API ----------------------------------------------------------
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
@@ -133,6 +140,48 @@ class TrainSession:
         shutil.copytree(src, dest)
         return dest
 
+    # -- elastic plane (live N->M reshard, ray_tpu/elastic/) ---------------
+    def keep_live(self, state: dict, *, sharded: Optional[dict] = None,
+                  meta: Optional[dict] = None, copy: bool = True):
+        """Register this step's state for live resharding. Call at the END
+        of each step with post-step state: on a resize/preemption the
+        elastic plane ships exactly this snapshot host-to-host and the
+        resumed fn reads it back via train.live_resume().
+
+        ``state``: {path: array} replicated leaves (every rank holds the
+        full array). ``sharded``: {path: (flat_1d, lo, n_total)} window
+        leaves — this rank's [lo, lo+len) slice of a logical length-n flat
+        array (ShardedOptimizerStep.live_shards() emits this shape).
+        ``meta``: small picklable dict returned verbatim on resume (step
+        counter, optimizer t, rng state...). ``copy=True`` snapshots leaves
+        with np.copy so in-place mutation by the NEXT step (adam slots)
+        cannot tear the parked bytes; pass False only for immutable (jax)
+        arrays."""
+        import numpy as _np
+
+        if self.stop_event.is_set():
+            raise RuntimeError("training was asked to stop")
+        if copy:
+            state = {k: _np.array(v, copy=True) for k, v in state.items()}
+            sharded = {k: (_np.array(a, copy=True), lo, n)
+                       for k, (a, lo, n) in (sharded or {}).items()}
+        with self._live_lock:
+            seq = (self._live["seq"] + 1) if self._live else 1
+            self._live = {"state": state, "sharded": dict(sharded or {}),
+                          "meta": dict(meta or {}), "seq": seq}
+
+    def live_snapshot(self) -> Optional[dict]:
+        """The last keep_live() registration (export path; None when the fn
+        never registered — the controller falls back to checkpoints)."""
+        with self._live_lock:
+            return self._live
+
+    def live_resume(self) -> Optional[dict]:
+        """The payload a live reshard delivered for this rank: {"state",
+        "sharded", "meta", "seq"} — or None (fresh start / checkpoint
+        resume)."""
+        return self.resume_live
+
     def collective_group(self) -> str:
         """Join (once, lazily) this run's host collective gang — group name
         ``train:<experiment>:w<world>``, ranks = the session's world ranks —
@@ -225,6 +274,12 @@ class TrainContext:
     def sharded_optimizer(self, optimizer: str = "adam", **kwargs):
         return self._s.sharded_optimizer(optimizer, **kwargs)
 
+    def should_stop(self) -> bool:
+        """True once the controller asked this gang to stop (graceful
+        resize/reshard): the fn should reach its next step boundary and
+        exit (keep_live/report will raise there)."""
+        return self._s.stop_event.is_set()
+
 
 def _set_session(s: "TrainSession | None"):
     global _session
@@ -277,6 +332,26 @@ def sharded_optimizer(optimizer: str = "adam", **kwargs):
     if s is None:
         raise RuntimeError("ray_tpu.train.sharded_optimizer() called outside a train worker")
     return s.sharded_optimizer(optimizer, **kwargs)
+
+
+def keep_live(state: dict, *, sharded: Optional[dict] = None,
+              meta: Optional[dict] = None, copy: bool = True):
+    """Register this step's state for live resharding (see
+    TrainSession.keep_live)."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.keep_live() called outside a train worker")
+    s.keep_live(state, sharded=sharded, meta=meta, copy=copy)
+
+
+def live_resume() -> Optional[dict]:
+    """The live-reshard payload for this rank ({"state", "sharded", "meta",
+    "seq"}), or None when this incarnation starts fresh / from a
+    checkpoint."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.live_resume() called outside a train worker")
+    return s.live_resume()
 
 
 def save_pytree_async(tree, metrics: dict, mesh: Optional[dict] = None):

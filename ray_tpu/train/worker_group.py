@@ -44,10 +44,16 @@ class TrainWorker:
             ip = socket.gethostbyname(host)
         except OSError:
             ip = "127.0.0.1"
+        from ray_tpu.core import api as _api
+
+        core = _api._require_worker()
         # The coordinator port must be free on THIS host (rank 0 binds it);
         # picking it elsewhere (driver/controller) races other machines.
+        # node_id/worker_addr: preemption-notice attribution + the elastic
+        # plane's raw-lane transfer endpoint.
         return {"hostname": host, "ip": ip, "pid": os.getpid(),
-                "free_port": _free_port()}
+                "free_port": _free_port(), "node_id": core.node_id,
+                "worker_addr": core.address}
 
     def setup_distributed(self, coordinator_addr: str, num_processes: int,
                           process_id: int, use_tpu: bool) -> bool:
@@ -71,7 +77,8 @@ class TrainWorker:
     # -- training lifecycle ------------------------------------------------
     def start(self, train_fn: Callable, config: dict,
               resume_checkpoint_path: Optional[str] = None,
-              dataset_shards: Optional[dict] = None) -> bool:
+              dataset_shards: Optional[dict] = None,
+              resume_live: Optional[dict] = None) -> bool:
         resume = Checkpoint(resume_checkpoint_path) if resume_checkpoint_path else None
         self.session = TrainSession(
             world_rank=self.world_rank,
@@ -81,6 +88,7 @@ class TrainWorker:
             storage_path=self.storage_path,
             resume_checkpoint=resume,
             dataset_shards=dataset_shards,
+            resume_live=resume_live,
         )
         self.error = None
         self.finished = False
@@ -111,6 +119,57 @@ class TrainWorker:
             self.session.stop_event.set()
         return True
 
+    # -- elastic plane (live N->M reshard, ray_tpu/elastic/) ---------------
+    def reshard_export(self, tid: str) -> Optional[dict]:
+        """Park this rank's last keep_live() snapshot for transfer ``tid``;
+        returns the export's wire metadata (None when the fn never
+        registered live state — the controller falls back to checkpoints)."""
+        from ray_tpu.core import api as _api
+        from ray_tpu.elastic import transfer as _transfer
+
+        snap = self.session.live_snapshot() if self.session else None
+        if snap is None:
+            return None
+        meta = _transfer.export_state(
+            tid, self.world_rank, snap["state"], snap["sharded"],
+            seq=snap["seq"], meta=snap["meta"])
+        meta["addr"] = _api._require_worker().address
+        return meta
+
+    def reshard_pull(self, tid: str, sources: list, world: int, rank: int,
+                     self_old_rank: Optional[int] = None) -> dict:
+        """Assemble this worker's slice of the new mesh's state from the
+        gang's live exports (raw-lane pulls; own-export runs are local
+        memcpys). The payload parks on the actor until restart_live()."""
+        from ray_tpu.core import api as _api
+        from ray_tpu.elastic import transfer as _transfer
+
+        core = _api._require_worker()
+        res = core._run(
+            _transfer.pull_state(core, tid, sources, world, rank,
+                                 self_rank=self_old_rank),
+            timeout=core.config.elastic_transfer_timeout_s * 4 + 10)
+        self._resumed = res
+        return res["stats"]
+
+    def reshard_release(self, tid: str) -> bool:
+        from ray_tpu.elastic import transfer as _transfer
+
+        return _transfer.release(tid)
+
+    def restart_live(self, train_fn: Callable, config: dict, world_rank: int,
+                     world_size: int,
+                     dataset_shards: Optional[dict] = None) -> bool:
+        """Resume the train fn on the resized mesh: adopt the (possibly
+        changed) rank/world, hand the fn the resharded payload via
+        train.live_resume(), and leave checkpoints out of the loop."""
+        resumed = getattr(self, "_resumed", None)
+        self._resumed = None
+        self.world_rank = world_rank
+        self.world_size = world_size
+        return self.start(train_fn, config, None, dataset_shards,
+                          resume_live=resumed)
+
 
 def _fn_wants_config(fn) -> bool:
     import inspect
@@ -123,16 +182,38 @@ def _fn_wants_config(fn) -> bool:
 
 
 class WorkerGroup:
-    """Creates the PG + actors; knows how to poll and tear down the gang."""
+    """Creates the PG + actors; knows how to poll and tear down the gang.
 
-    def __init__(self, scaling: ScalingConfig, experiment_name: str, storage_path: str):
+    ``gang_pg=False`` (the elastic-live mode) schedules workers by plain
+    resources instead of one N-bundle placement group: a live resize keeps
+    surviving actors and adds/drops members, which a fixed-bundle PG cannot
+    express — elastic gangs trade strict gang placement for resize-in-place.
+    """
+
+    def __init__(self, scaling: ScalingConfig, experiment_name: str,
+                 storage_path: str, gang_pg: bool = True):
         self.scaling = scaling
         self.experiment_name = experiment_name
         self.storage_path = storage_path
+        self.gang_pg = gang_pg
         self.pg = None
         self.reservation = None
         self.workers: list = []
+        self.node_ids: list = []  # parallel to workers (preemption matching)
         self._split_coordinators: list = []
+
+    def _spawn(self, rank: int, n: int):
+        res = self.scaling.worker_resources()
+        worker_cls = rt.remote(TrainWorker)
+        opts: dict = {"resources": dict(res),
+                      "max_concurrency": 4}  # poll/stop can't block start()
+        if self.pg is not None:
+            opts.update(placement_group=self.pg,
+                        placement_group_bundle_index=rank)
+        if self.reservation is not None:
+            opts.update(label_selector=dict(self.reservation.label_selector))
+        return worker_cls.options(**opts).remote(
+            rank, n, self.experiment_name, self.storage_path)
 
     def start(self) -> None:
         n = self.scaling.num_workers
@@ -147,29 +228,21 @@ class WorkerGroup:
             )
             if self.reservation is not None:
                 label_selector.update(self.reservation.label_selector)
-        bundles = [dict(res) for _ in range(n)]
-        self.pg = rt.placement_group(
-            bundles, strategy=self.scaling.placement_strategy,
-            name=f"{self.experiment_name}-gang",
-            label_selector=label_selector,
-        )
-        if not self.pg.ready(timeout=60.0):
-            raise TimeoutError(
-                f"placement group for {n} train workers not schedulable: {bundles}"
+        if self.gang_pg:
+            bundles = [dict(res) for _ in range(n)]
+            self.pg = rt.placement_group(
+                bundles, strategy=self.scaling.placement_strategy,
+                name=f"{self.experiment_name}-gang",
+                label_selector=label_selector,
             )
-        worker_cls = rt.remote(TrainWorker)
-        self.workers = [
-            worker_cls.options(
-                placement_group=self.pg,
-                placement_group_bundle_index=i,
-                resources=dict(res),
-                label_selector=dict(label_selector),
-                max_concurrency=4,  # poll/stop must not block behind start()
-            ).remote(i, n, self.experiment_name, self.storage_path)
-            for i in range(n)
-        ]
+            if not self.pg.ready(timeout=60.0):
+                raise TimeoutError(
+                    f"placement group for {n} train workers not schedulable: {bundles}"
+                )
+        self.workers = [self._spawn(i, n) for i in range(n)]
         # Health barrier + rendezvous.
         addrs = rt.get([w.get_address.remote() for w in self.workers], timeout=60)
+        self.node_ids = [a.get("node_id", "") for a in addrs]
         coordinator = f"{addrs[0]['ip']}:{addrs[0]['free_port']}"
         rt.get(
             [
@@ -181,19 +254,33 @@ class WorkerGroup:
             timeout=120,
         )
 
-    def run(self, train_fn: Callable, config: dict,
-            resume_checkpoint_path: Optional[str] = None,
-            datasets: Optional[dict] = None) -> None:
-        # Fresh streaming splits per gang incarnation: a restarted gang must
-        # not consume a half-drained epoch from the previous one (reference:
-        # DataConfig.configure runs per worker-group start).
-        shards_per_worker: list[dict] = [{} for _ in self.workers]
+    def make_shards(self, datasets: Optional[dict], n: int) -> list[dict]:
+        """Fresh streaming splits per gang incarnation (and per live
+        resize): a restarted/resized gang must not consume a half-drained
+        epoch from the previous one (reference: DataConfig.configure runs
+        per worker-group start). The PREVIOUS incarnation's split
+        coordinators die here — a long-lived elastic job resizes in place
+        without ever reaching shutdown(), and keeping one coordinator per
+        dataset per resize alive would leak them for the run's lifetime."""
+        for coord in self._split_coordinators:
+            try:
+                rt.kill(coord)
+            except Exception:
+                pass
+        self._split_coordinators = []
+        shards_per_worker: list[dict] = [{} for _ in range(n)]
         for ds_name, ds in (datasets or {}).items():
-            iterators = ds.streaming_split(len(self.workers))
+            iterators = ds.streaming_split(n)
             # Coordinator actors die with the gang (shutdown), not the cluster.
             self._split_coordinators.append(iterators[0]._coord)
             for i, it in enumerate(iterators):
                 shards_per_worker[i][ds_name] = it
+        return shards_per_worker
+
+    def run(self, train_fn: Callable, config: dict,
+            resume_checkpoint_path: Optional[str] = None,
+            datasets: Optional[dict] = None) -> None:
+        shards_per_worker = self.make_shards(datasets, len(self.workers))
         rt.get(
             [
                 w.start.remote(train_fn, config, resume_checkpoint_path,
@@ -228,6 +315,43 @@ class WorkerGroup:
                 rt.get(r, timeout=10)
             except Exception:
                 pass
+
+    def spawn_extra(self, k: int) -> list:
+        """Fresh member actors for a live grow (ranks assigned later by
+        restart_live). Only valid without a gang PG — a fixed-bundle PG
+        cannot grow."""
+        if self.pg is not None:
+            raise RuntimeError("cannot grow a PG-pinned gang in place")
+        spawned = [self._spawn(len(self.workers) + i, len(self.workers) + k)
+                   for i in range(k)]
+        try:
+            addrs = rt.get([w.get_address.remote() for w in spawned], timeout=60)
+        except Exception:
+            # A failed health barrier must not orphan the actors: they are
+            # not yet in self.workers, so nothing else would ever kill
+            # them, and their reservations would starve the fallback gang.
+            for w in spawned:
+                try:
+                    rt.kill(w)
+                except Exception:
+                    pass
+            raise
+        self.workers += spawned
+        self.node_ids += [a.get("node_id", "") for a in addrs]
+        return spawned
+
+    def adopt(self, workers: list, node_ids: list) -> None:
+        """Live resize membership swap: ``workers`` (old-rank order becomes
+        new-rank order) stay; every other current member is killed."""
+        keep = {id(w) for w in workers}
+        for w in self.workers:
+            if id(w) not in keep:
+                try:
+                    rt.kill(w)
+                except Exception:
+                    pass
+        self.workers = list(workers)
+        self.node_ids = list(node_ids)
 
     def shutdown(self) -> None:
         for w in self.workers:

@@ -166,6 +166,7 @@ def test_worker_kill_scenario_smoke():
     assert report["details"]["retried_attempts"] >= 1
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_overload_storm_scenario_smoke():
     """The QoS acceptance scenario: ~3x overload with chaos-injected replica
     slowness — interactive goodput holds (p99 bounded), every shed/expiry is
@@ -178,6 +179,7 @@ def test_overload_storm_scenario_smoke():
     assert report["invariants"]["faults_visible_in_metrics"]["ok"]
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_autoscale_flap_scenario_smoke():
     """The scale-plane acceptance scenario: chaos-delayed replica startup
     (site scale.replica.start) under sustained load — the policy upscales,
@@ -190,6 +192,7 @@ def test_autoscale_flap_scenario_smoke():
                for d in report["details"]["applied_decisions"])
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_ring_link_loss_scenario_smoke():
     """The collective-plane acceptance scenario: ring frames dropped and
     corrupted in flight — every rank fails with a typed CollectiveError

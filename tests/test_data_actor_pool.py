@@ -106,6 +106,7 @@ def test_fn_constructor_args_rejected_for_tasks(pool_ray):
 # LLM batch inference stage
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_llm_batch_generate(pool_ray):
     from ray_tpu.llm import batch_generate
 

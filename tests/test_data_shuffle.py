@@ -74,6 +74,7 @@ def test_join_column_collision_suffix():
     assert row["v"] == "L" and row["v_1"] == "R"
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_shuffle_beyond_memory_with_spill(tmp_path):
     """Groupby+join at > object-store scale: the 16MB store must spill to
     disk and the shuffle still completes exactly."""

@@ -66,6 +66,7 @@ def test_vtrace_on_policy_reduces_to_lambda1_gae_targets():
     np.testing.assert_allclose(np.asarray(vs) - values, adv, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_impala_learns_cartpole():
     """Async decoupled sampling + v-trace solves CartPole (>=450 mean
     return). Measured on this host, IMPALA reaches 450 in ~105s / ~230k env

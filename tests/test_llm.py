@@ -36,6 +36,7 @@ def engine():
     return LLMEngine(CFG, engine_config=EngineConfig(max_slots=4, max_seq=128, prefill_buckets=(16, 32, 64)))
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_cached_decode_matches_full_forward(engine):
     prompt = np.array([5, 17, 42, 7, 23], np.int32)
     want = _naive_greedy(engine.params, prompt, 12)
@@ -93,6 +94,7 @@ def test_eos_stops_generation():
         assert out["tokens"].index(0) == len(out["tokens"]) - 1
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_llm_serve_deployment():
     import ray_tpu as rt
     from ray_tpu import serve

@@ -226,6 +226,7 @@ def test_attention_reference_causal():
     np.testing.assert_allclose(o[:, 0], o0[:, 0], rtol=1e-5)
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_graft_entry_contract():
     import sys
     sys.path.insert(0, "/root/repo")

@@ -76,6 +76,7 @@ def test_lru_eviction_under_page_pressure():
     assert again["tokens"] == outs[-1]
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_cold_warm_ttft_gap():
     """Cache-hit TTFT beats cold TTFT (the routing payoff): prefilling a
     ~500-token prompt costs real compute; the hit replaces it with a page
@@ -206,6 +207,7 @@ def test_partial_hit_retire_shares_prefix_pages():
     assert eng._page_refs[first] >= 4
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_admission_does_not_evict_its_own_prefix():
     """Under page pressure a request must not evict the very entry it is
     about to hit (lookup now precedes eviction, hit entry protected)."""
@@ -225,6 +227,7 @@ def test_admission_does_not_evict_its_own_prefix():
     assert out["tokens"] == cold["tokens"]
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_partial_hit_ttft_beats_cold():
     """Tail prefill over cached pages is measurably cheaper than a cold
     full prefill (the routing payoff for shared system prompts). Programs

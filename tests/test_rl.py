@@ -61,6 +61,7 @@ def test_numpy_and_jax_forwards_agree():
     np.testing.assert_allclose(nv, np.asarray(jv), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_ppo_learns_cartpole(shared_ray):
     algo = PPOConfig(
         num_env_runners=2,

@@ -97,6 +97,7 @@ def test_shared_policy_learns_cue_match():
         algo.stop()
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_per_agent_policies_learn_independently():
     """policy_mapping_fn routes each agent to its own policy; both learn,
     and the two learners really hold different weights (independent

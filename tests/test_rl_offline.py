@@ -69,6 +69,7 @@ def test_offline_dataset_roundtrip_and_batches(tmp_path):
     assert n == 2 * (1_000 // 256)
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_bc_clones_competent_cartpole_policy():
     """BC recovers a competent discrete policy from logged data alone
     (reference: rllib/algorithms/bc): trained on noisy-teacher rollouts,

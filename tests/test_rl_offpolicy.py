@@ -162,6 +162,7 @@ def test_buffer_actor_backpressure(shared_ray):
     rt.kill(buf)
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_dqn_learns_cartpole_with_overlap(shared_ray):
     algo = DQNConfig(
         num_env_runners=2,

@@ -88,6 +88,7 @@ def _write_pkg(root, name, version):
     return pkg
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_pip_venv_isolation_and_cache(shared_ray, tmp_path):
     """Two actors with CONFLICTING package versions coexist on one cluster
     (each runs from its own cached venv — reference: _private/runtime_env/

@@ -457,6 +457,7 @@ class Busy:
         return "ok"
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_autoscaler_scales_to_three_replicas_and_goodput_grows(scale_cluster):
     """The e2e scale-out: an overload_storm-shaped flood against an
     autoscaling deployment. The AUTOSCALER (not a static count) must grow

@@ -191,6 +191,7 @@ def test_serve_batch_groups_requests(serve_cluster):
 # autoscaling
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_autoscaling_up_and_down(serve_cluster):
     @serve.deployment(
         max_ongoing_requests=4,

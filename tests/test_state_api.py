@@ -259,6 +259,7 @@ def _wait_for(fn, timeout=20.0, what="condition"):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_state_api_live_cluster(tmp_path):
     from ray_tpu import state
 

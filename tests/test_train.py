@@ -205,6 +205,7 @@ def _jax_train_fn(config):
             train.report({"step": i, "loss": float(m["loss"])})
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_jax_trainer_end_to_end(shared_ray, tmp_path):
     from ray_tpu.train import JaxTrainer
 

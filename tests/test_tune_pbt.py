@@ -8,6 +8,7 @@ from ray_tpu import tune
 from ray_tpu.tune.search import grid_search
 
 
+@pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_pbt_improves_population(tmp_path):
     """PBT on fake v4-16 TPU slices: bad lr trials clone good ones and the
     whole population converges (BASELINE.md Tune target)."""

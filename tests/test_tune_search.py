@@ -13,6 +13,9 @@ import time
 import numpy as np
 import pytest
 
+# Search-algorithm batteries (TPE/BOHB/median-stopping statistical runs dominate the tier-1 budget); tier-1 runs -m "not slow".
+pytestmark = pytest.mark.slow
+
 import ray_tpu as rt
 from ray_tpu import tune
 from ray_tpu.train.config import RunConfig
