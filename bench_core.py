@@ -142,6 +142,16 @@ def bench_actor_sync(n):
         armed = timed(run, n)
     finally:
         chaos.uninstall()
+    # Flight-recorder A/B (ISSUE 15 quiet-path contract): the headline runs
+    # with the always-on ring armed; disabling it strips the one deque
+    # append per absorbed event, so the delta IS the black box's cost.
+    from ray_tpu.obs import flight as _flight
+
+    _flight.set_enabled(False)
+    try:
+        recorder_off = timed(run, n)
+    finally:
+        _flight.set_enabled(True)
     off_ops, on_ops, armed_ops = n / elapsed, n / traced, n / armed
     # The headline row stays tracing-OFF (comparable across rounds); the
     # on/off A/Bs ride in detail so BENCH_CORE.json tracks observability
@@ -156,6 +166,11 @@ def bench_actor_sync(n):
             "off_ops_s": round(off_ops, 1),
             "armed_noop_ops_s": round(armed_ops, 1),
             "overhead_pct": round((off_ops / armed_ops - 1.0) * 100.0, 2),
+        },
+        "obs_overhead": {
+            "recorder_off_ops_s": round(n / recorder_off, 1),
+            "recorder_on_ops_s": round(off_ops, 1),
+            "overhead_pct": round((elapsed / recorder_off - 1.0) * 100.0, 2),
         },
     })
 

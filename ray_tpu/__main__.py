@@ -100,6 +100,73 @@ def cmd_drain(args):
         )
 
 
+def cmd_slo(args):
+    """SLO objective status: state, multi-window burn rates, alert counts."""
+    rt = _connect(args.address)
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    rows = core._run(core.controller.call("slo_status", {}))
+    if args.json:
+        print(json.dumps(rows, default=str))
+        return
+    if not rows:
+        print("no SLO objectives registered "
+              "(serve.register_slo(...) or config slo_spec)")
+        return
+    for r in rows:
+        o = r["objective"]
+        scope = "/".join(x for x in (o["app"], o["deployment"], o["cls"], o["tenant"]) if x) or "*"
+        bf = "-" if r["burn_fast"] is None else f"{r['burn_fast']:.1f}"
+        bs = "-" if r["burn_slow"] is None else f"{r['burn_slow']:.1f}"
+        print(f"{o['name']:28s} {r['state']:8s} {o['metric']:12s} scope={scope} "
+              f"burn fast={bf} slow={bs} alerts={r['alerts_fired']}")
+
+
+def cmd_debug(args):
+    """Observability debug verbs. `debug dump <worker_addr>` asks one worker
+    to write a manual flight-recorder dump and prints where it landed."""
+    rt = _connect(args.address)
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+
+    async def go():
+        conn = await core._peer_conn(args.worker_addr)
+        return await conn.call("flight_dump", {"reason": args.reason}, timeout=30)
+
+    out = core._run(go())
+    if not out.get("path"):
+        raise SystemExit(f"dump failed (recorder disabled or dir unwritable): {out}")
+    print(f"flight dump: {out['path']}")
+    print(f"  ring: {out.get('len', '?')} events held, "
+          f"{out.get('events_evicted', 0):g} evicted, "
+          f"{out.get('dumps_written', 0):g} dumps written by this process")
+
+
+def cmd_trace(args):
+    """`trace export <trace_id>`: reassemble a FULL trace from every live
+    per-process flight recorder plus the controller index — works even after
+    the bounded index evicted the trace — and write a Perfetto timeline."""
+    rt = _connect(args.address)
+    from ray_tpu import obs
+    from ray_tpu.util import tracing
+
+    res = obs.collect_flight_trace(args.trace_id)
+    events = res.get("events", [])
+    if not events:
+        if res.get("evicted"):
+            raise SystemExit(
+                f"trace {args.trace_id} was evicted from the controller index "
+                "and no live recorder still holds it (the rings are bounded)")
+        raise SystemExit(f"trace {args.trace_id}: no events anywhere — unknown trace id?")
+    n = tracing.render_timeline(events, args.out)
+    note = " (recovered after index eviction)" if res.get("evicted") else ""
+    print(f"wrote {n} events from {res.get('sources', 0)} recorder(s) to {args.out}{note}")
+    for err in res.get("errors", []):
+        print(f"  warning: {err}")
+
+
 def cmd_profile(args):
     """On-demand CPU profile of a running worker (py-spy-equivalent)."""
     rt = _connect(args.address)
@@ -155,6 +222,18 @@ def main(argv=None):
     pr.add_argument("--duration", type=float, default=2.0)
     pr.add_argument("--top", type=int, default=10)
     pr.add_argument("--depth", type=int, default=4)
+    sp = sub.add_parser("slo", help="SLO objective status (burn rates, alerts)")
+    sp.add_argument("--json", action="store_true", help="raw status rows")
+    dbg = sub.add_parser("debug", help="observability debug verbs")
+    dsub = dbg.add_subparsers(dest="debug_cmd", required=True)
+    dd = dsub.add_parser("dump", help="manual flight-recorder dump of one worker")
+    dd.add_argument("worker_addr", help="worker IP:PORT (see `list workers`)")
+    dd.add_argument("--reason", default="manual CLI dump")
+    tr = sub.add_parser("trace", help="trace reassembly from live flight recorders")
+    trsub = tr.add_subparsers(dest="trace_cmd", required=True)
+    te = trsub.add_parser("export", help="rebuild one trace, write a Perfetto timeline")
+    te.add_argument("trace_id")
+    te.add_argument("--out", default="trace.json")
     args = p.parse_args(argv)
     if args.cmd == "lint":
         sys.exit(cmd_lint(args))
@@ -177,6 +256,9 @@ def main(argv=None):
         "dashboard": cmd_dashboard,
         "drain": cmd_drain,
         "profile": cmd_profile,
+        "slo": cmd_slo,
+        "debug": cmd_debug,
+        "trace": cmd_trace,
     }[args.cmd](args)
 
 

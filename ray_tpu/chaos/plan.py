@@ -312,6 +312,13 @@ def _record(fault: Fault, ctx: dict) -> None:
         _LOG_DROPPED += trim
     # Inside the affected task/pull span when one is active; no-op otherwise.
     _tracing.event("chaos.injected", site=fault.site, kind=fault.kind, hit=fault.hit)
+    # Flight recorder: a worker.death dump must show the kill that caused it
+    # (the tracing.event above only lands when a trace is active). Chaos may
+    # call out to obs; the chaos-gate lint forbids the reverse direction.
+    from ray_tpu.obs import flight as _flight
+
+    _flight.record("chaos.injected", site=fault.site, fault_kind=fault.kind,
+                   rule=fault.rule_index, hit=fault.hit)
 
 
 def injection_log(normalize: bool = False) -> list:

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 
 from ray_tpu.chaos import plan as _plan
 from ray_tpu.chaos import invariants as _inv
+from ray_tpu.obs import flight as _flight
 
 
 class ScenarioFailure(AssertionError):
@@ -126,9 +127,42 @@ def _scn_worker_kill(seed: int, quick: bool) -> dict:
     out = core._run(core.controller.call("list_tasks", {"fn": "work", "limit": 200}))
     retried = [t for t in out.get("tasks", []) if t.get("attempt", 0) > 0]
     _require(bool(retried), "no retried attempt in the task index — the kill never landed")
+    # Observability invariant: every injected kill leaves a black box. The
+    # dying worker dumps its flight ring before os._exit, the daemon
+    # harvests the file when it reaps the process, and the controller
+    # indexes the path — so the scenario can load the post-mortem and
+    # demand it attributes the in-flight task the kill took down.
+    dumps: list = []
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not dumps:
+        out = core._run(core.controller.call("list_flight_dumps", {}))
+        dumps = [d for d in out.get("dumps", []) if d.get("trigger") == "worker.death"]
+        if not dumps:
+            time.sleep(0.25)
+    _require(bool(dumps), "worker.exec kill left no flight dump behind")
+    header, events = _flight.load_dump(dumps[0]["path"])
+    _require(header.get("trigger") == "worker.death",
+             f"dump carries the wrong trigger: {header.get('trigger')!r}")
+    _require(bool(events), "flight dump parsed empty — the black box recorded nothing")
+    aut = _flight.dump_autopsy(events)
+    running = [t for t in aut["in_flight"] if t.get("state") == "RUNNING"]
+    _require(bool(running),
+             "dump autopsy shows no in-flight RUNNING task — the post-mortem "
+             "cannot attribute what the kill interrupted")
     return {
         "cluster": cluster,
-        "details": {"tasks": n, "retried_attempts": len(retried)},
+        "details": {
+            "tasks": n,
+            "retried_attempts": len(retried),
+            "flight_dump": {
+                "trigger": header.get("trigger"),
+                "events": len(events),
+                "in_flight": [t.get("task_id", "")[:8] for t in running],
+                # Replay-diff form: two same-seed runs must produce an
+                # identical normalized event sequence (determinism check).
+                "normalized": _flight.normalize_dump(events),
+            },
+        },
         "min_injections": 0,
         "min_metric_injections": 0,
     }
@@ -382,6 +416,9 @@ def _scn_overload_storm(seed: int, quick: bool) -> dict:
     cfg.qos_min_concurrency = 2
     cfg.qos_initial_concurrency = 8
     cfg.qos_adapt_interval_s = 0.25
+    # Fast SLO evaluation ticks: the burn-rate alert must fire INSIDE the
+    # storm window (the objective below uses storm-sized windows to match).
+    cfg.slo_eval_interval_s = 0.25
     cfg.chaos_spec = json.dumps({
         "seed": seed,
         "rules": [{"site": "serve.replica.slow", "kind": "delay",
@@ -410,6 +447,20 @@ def _scn_overload_storm(seed: int, quick: bool) -> dict:
 
     serve.run(Slowpoke.bind(), name="storm", route_prefix="/storm")
     port = serve.http_port()
+
+    # SLO plane under fire: an availability objective scoped to this app,
+    # with storm-sized burn windows. The quiet path (pre-flood) must sit at
+    # "ok"; the storm must drive a multi-window burn-rate ALERT.
+    serve.register_slo({
+        "name": "storm-availability", "metric": "availability",
+        "app": "storm", "deployment": "Slowpoke",
+        "fast_window_s": 1.0, "slow_window_s": 3.0, "burn_threshold": 2.0,
+    })
+    time.sleep(1.0)  # a few idle evaluation ticks
+    rows = serve.slo_status()
+    row = next(r for r in rows if r["objective"]["name"] == "storm-availability")
+    _require(row["state"] == "ok" and row["alerts_fired"] == 0,
+             f"SLO alerted on an idle deployment (quiet path not alert-free): {row}")
 
     # Baseline the QoS counters BEFORE the load: the driver's metric
     # registry is process-global and may carry counts from earlier sessions
@@ -526,6 +577,18 @@ def _scn_overload_storm(seed: int, quick: bool) -> dict:
     _require(invoked == total_200,
              f"replica invoked user code {invoked}x but only {total_200} requests "
              "succeeded — a shed or expired request reached the callable")
+
+    # -- the storm must have driven the SLO objective into alert ----------
+    row = next(r for r in serve.slo_status()
+               if r["objective"]["name"] == "storm-availability")
+    _require(row["alerts_fired"] >= 1,
+             f"sustained overload never fired the burn-rate alert: {row}")
+    slo_events = [
+        e for e in core._run(core.controller.call("get_events", {"limit": 4000}))
+        if e.get("kind") == "slo_state" and e.get("objective") == "storm-availability"
+    ]
+    _require(any(e.get("state") == "alert" for e in slo_events),
+             f"no slo_state=alert event in the controller log: {slo_events}")
     from ray_tpu.serve.handle import _reset_registry
 
     _reset_registry()  # park router threads before the invariant battery
@@ -536,6 +599,8 @@ def _scn_overload_storm(seed: int, quick: bool) -> dict:
             "interactive_p99_s": round(p99, 3),
             "shed": shed_observed, "expired": expired_observed,
             "invoked": invoked,
+            "slo": {"state": row["state"], "alerts_fired": row["alerts_fired"],
+                    "burn_fast": row["burn_fast"], "burn_slow": row["burn_slow"]},
         },
         # Every invocation rode one injected serve.replica.slow delay.
         "min_injections": 0,  # injections happen in the REPLICA process, not here
@@ -1247,9 +1312,16 @@ def run_scenario(name: str, seed: int = 0, quick: bool = False) -> dict:
         report["invariants"] = inv
         report["injections"] = _plan.injection_log(normalize=True)
         report["ok"] = inv["ok"]
+        if not inv["ok"]:
+            report["flight_dump"] = _flight.dump(
+                "chaos.invariant", reason=f"{name}: invariant battery failed")
     except ScenarioFailure as e:
         report["error"] = str(e)
         report["injections"] = _plan.injection_log(normalize=True)
+        # A failed chaos invariant is exactly the moment the driver-side
+        # ring is worth keeping: dump it next to the report.
+        report["flight_dump"] = _flight.dump(
+            "chaos.invariant", reason=f"{name}: {e}")
     except Exception as e:  # noqa: BLE001 - a lost task surfaces as GetTimeoutError etc.
         # The MOST interesting chaos outcome is an unexpected exception (a
         # get timeout IS the lost-task symptom this plane hunts): it must

@@ -176,6 +176,31 @@ class Config:
     # Empty (the default) keeps the chaos plane entirely off — the gate is a
     # single attribute load + None check (bench detail.chaos_overhead).
     chaos_spec: str = ""
+    # --- observability plane (flight recorder / SLO engine; ray_tpu/obs/) ---
+    # Per-process flight-recorder ring capacity (events). The recorder only
+    # tees events other planes already emit, so the knob trades post-mortem
+    # depth against resident memory, never request-path cost.
+    obs_flight_ring: int = 4096
+    # Dump directory. Empty -> <tempdir>/raytpu_flight for drivers; node
+    # daemons override per-worker via RAYTPU_FLIGHT_DIR to <log_dir>/flight
+    # so last-gasp dumps land next to the worker logs they explain.
+    obs_flight_dir: str = ""
+    # Deadline-storm dump trigger: this many qos expiries inside the window
+    # dumps the ring (the process is missing deadlines wholesale; the ring
+    # currently holds why).
+    obs_storm_expiries: int = 50
+    obs_storm_window_s: float = 5.0
+    # Event-loop lag probe cadence (obs/health.py); 0 disables the probe.
+    # Spikes past obs_loop_spike_s drop a thread dump into the recorder.
+    obs_loop_probe_interval_s: float = 0.25
+    obs_loop_spike_s: float = 0.25
+    # Declarative SLOs armed at controller start: JSON list of objective
+    # specs (see obs/slo.py docstring). The serve API / `raytpu slo` can
+    # add more at runtime.
+    slo_spec: str = ""
+    # Controller SLO evaluation cadence: each tick samples the merged
+    # reporter series into every objective's window and re-judges burn rates.
+    slo_eval_interval_s: float = 1.0
     # --- security ---
     # OPT-IN per-session shared secret for the RPC layer (pickle-over-TCP
     # executes code on unpickle; with a token set, every frame carries an

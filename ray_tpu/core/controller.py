@@ -29,6 +29,10 @@ from ray_tpu.core import rpc
 from ray_tpu.core import task_state as _ts
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.obs import _merge_events as _merge_trace_events
+from ray_tpu.obs import autopsy as _autopsy
+from ray_tpu.obs import flight as _flight
+from ray_tpu.obs import slo as _slo
 from ray_tpu.util import tracing as _tracing
 from ray_tpu.util.bgtasks import spawn_bg as _spawn_bg_task
 
@@ -210,6 +214,16 @@ class Controller:
         self.ckpt_channels: dict[str, dict] = {}
         self.ckpt_evicted = 0  # registry rows dropped by the bound
         self.MAX_CKPT_REGISTRY = 512
+        # Observability plane: the flight-dump registry ("where is the
+        # post-mortem" index — workers/daemons report every black-box dump
+        # path here) and the SLO burn-rate engine (objectives seeded from
+        # config.slo_spec; more arrive at runtime via slo_register).
+        self.flight_dumps: list[dict] = []
+        self.flight_dumps_dropped = 0  # dump records lost to the registry bound
+        self.MAX_FLIGHT_DUMPS = 256
+        self.slo_engine = _slo.SloEngine()
+        if config.slo_spec:
+            self._load_slo_spec(config.slo_spec)
         self._dirty = False
         # Actors restored from a snapshot as ALIVE/RESTARTING must be
         # re-confirmed by their daemon's re-registration within the grace
@@ -228,6 +242,7 @@ class Controller:
             _chaos.install_from_json(self.config.chaos_spec)
         addr = await self.server.start(port)
         self._bg.append(asyncio.create_task(self._health_check_loop()))
+        self._bg.append(asyncio.create_task(self._slo_eval_loop()))
         if self.persist_path:
             self._bg.append(asyncio.create_task(self._snapshot_loop()))
         logger.info("controller listening on %s", addr)
@@ -258,12 +273,74 @@ class Controller:
     def _event(self, kind: str, **kw):
         # tracing.now(): one clock across controller events, worker task
         # events, and spans (comparable timestamps in merged views).
-        self.events.append({"ts": _tracing.now(), "kind": kind, **kw})
+        ev = {"ts": _tracing.now(), "kind": kind, **kw}
+        self.events.append(ev)
+        # Tee into the head process's flight recorder: a controller crash
+        # dump then carries the control-plane decisions (node_dead,
+        # slo_state, chaos events) next to the spans.
+        _flight.absorb(ev)
         self._dirty = True
         if len(self.events) > self.config.event_buffer_size:
             trimmed = len(self.events) // 2
             self.events_dropped += trimmed
             del self.events[:trimmed]
+
+    # -- SLO burn-rate engine (observability plane) ----------------------
+    def _load_slo_spec(self, spec_json: str):
+        """Objectives declared in config (RAYTPU_SLO_SPEC / slo_spec): a JSON
+        object or list of objects in obs/slo.py spec format. Bad entries are
+        rejected loudly and individually — one typo must not disarm the rest."""
+        import json
+
+        try:
+            specs = json.loads(spec_json)
+        except ValueError as e:
+            logger.error("slo_spec is not valid JSON, ignored: %s", e)
+            return
+        for spec in specs if isinstance(specs, list) else [specs]:
+            try:
+                self.slo_engine.register(spec)
+            except (TypeError, ValueError) as e:
+                logger.error("slo objective rejected: %r (%s)", spec, e)
+
+    async def _slo_eval_loop(self):
+        """Re-evaluate every objective against the SAME merged series the
+        dashboard scrapes (google-SRE multi-window burn rates, obs/slo.py).
+        State changes become event-log entries; ALERT transitions are also
+        stamped onto recently-active traces so a latency investigation that
+        starts from a trace sees the burn alert in-line with the spans."""
+        while True:
+            await asyncio.sleep(max(0.1, self.config.slo_eval_interval_s))
+            if not self.slo_engine.trackers:
+                continue  # quiet path: no objectives, no work
+            try:
+                series = self.handle_get_metrics(None, {})
+            except Exception:
+                logger.exception("slo metrics snapshot failed")
+                continue
+            now = _tracing.now()
+            for row in self.slo_engine.ingest(now, series):
+                self._event("slo_state", objective=row["objective"]["name"],
+                            state=row["state"], burn_fast=row["burn_fast"],
+                            burn_slow=row["burn_slow"])
+                if row["state"] == _slo.ALERT:
+                    self._stamp_slo_alert(now, row)
+
+    def _stamp_slo_alert(self, now: float, row: dict):
+        """Append one alert point-event inside every recently-active indexed
+        trace (bounded scan; per-trace caps still apply, counted)."""
+        ev = {"ts": now, "kind": "slo_alert", "name": row["objective"]["name"],
+              "state": row["state"], "worker": "controller"}
+        horizon = now - row["objective"].get("fast_window_s", 60.0)
+        for i, t in enumerate(reversed(list(self.traces.values()))):
+            if i >= 64:
+                break  # bounded: newest 64 traces is "recently active"
+            if t["end"] < horizon:
+                continue
+            if len(t["events"]) < self.MAX_TRACE_EVENTS:
+                t["events"].append(ev)
+            else:
+                t["dropped"] += 1
 
     # -- persistence (control-plane fault tolerance) --------------------
     async def _snapshot_loop(self):
@@ -579,12 +656,16 @@ class Controller:
                     worker_dropped += rec["value"]
         return {
             "events": events,
+            # Black-box dump paths (newest first): the "where is the
+            # post-mortem" pointer right next to the event stream.
+            "flight_dumps": list(reversed(self.flight_dumps[-20:])),
             "dropped": {
                 "controller_events": self.events_dropped,
                 "task_events": self.task_events_dropped,
                 "worker_events": worker_dropped,
                 "traces_evicted": self.traces_evicted,
                 "tasks_evicted": self.tasks_evicted,
+                "flight_dumps": self.flight_dumps_dropped,
             },
         }
 
@@ -696,8 +777,15 @@ class Controller:
         t = self.traces.get(trace_id)
         if t is None:
             while len(self.traces) >= self.MAX_TRACES:
-                self.traces.pop(next(iter(self.traces)))  # evict oldest trace
+                victim_id = next(iter(self.traces))  # evict oldest trace
+                victim = self.traces.pop(victim_id)
                 self.traces_evicted += 1
+                # Name WHAT was lost, not just that something was: a later
+                # "trace not found" can then distinguish evicted-but-maybe-
+                # recoverable (collect_flight_trace re-assembles from live
+                # recorder rings) from never-existed.
+                self._event("trace_evicted", trace_id=victim_id,
+                            name=victim["name"], spans=victim["spans"])
             t = self.traces[trace_id] = {
                 "name": "", "start": ev["ts"], "end": ev["ts"],
                 "spans": 0, "workers": set(), "events": [], "dropped": 0,
@@ -1014,6 +1102,115 @@ class Controller:
     def handle_ckpt_latest(self, conn, p):
         return self.ckpt_channels.get(p["channel"])
 
+    # -- observability plane (SLO API / flight dumps / autopsy) ----------
+    def handle_slo_register(self, conn, p):
+        try:
+            spec = self.slo_engine.register(p["spec"])
+        except (TypeError, ValueError) as e:
+            return {"ok": False, "error": str(e)}
+        self._event("slo_registered", objective=spec["name"])
+        return {"ok": True, "objective": spec}
+
+    def handle_slo_unregister(self, conn, p):
+        ok = self.slo_engine.unregister(p["name"])
+        if ok:
+            self._event("slo_unregistered", objective=p["name"])
+        return ok
+
+    def handle_slo_status(self, conn, p):
+        return self.slo_engine.status()
+
+    def handle_slo_summary(self, conn, p):
+        return self.slo_engine.summary()
+
+    def handle_report_flight_dump(self, conn, p):
+        """A worker/daemon just wrote (or harvested) a black-box flight dump;
+        index the path so `raytpu debug` and /api/events can point at it."""
+        rec = {"ts": _tracing.now(), "proc": p.get("proc", ""),
+               "path": p.get("path", ""), "trigger": p.get("trigger", ""),
+               "node_id": p.get("node_id", ""), "reason": p.get("reason", "")}
+        self.flight_dumps.append(rec)
+        if len(self.flight_dumps) > self.MAX_FLIGHT_DUMPS:
+            trimmed = len(self.flight_dumps) - self.MAX_FLIGHT_DUMPS
+            self.flight_dumps_dropped += trimmed
+            del self.flight_dumps[:trimmed]
+        self._event("flight_dump", proc=rec["proc"], trigger=rec["trigger"],
+                    path=rec["path"])
+        return True
+
+    def handle_list_flight_dumps(self, conn, p):
+        out = self._truncate(list(reversed(self.flight_dumps)), int(p.get("limit", 50)))
+        out["dumps"] = out.pop("items")
+        out["dropped"] = self.flight_dumps_dropped
+        return out
+
+    async def handle_collect_flight_trace(self, conn, p):
+        """Reassemble ONE trace from every live per-process flight recorder
+        (daemons fan out to their workers) merged with whatever the bounded
+        trace index still holds — this is what makes `raytpu trace export`
+        work even after the index evicted the trace."""
+        trace_id = p["trace_id"]
+
+        async def one(node: NodeRecord):
+            try:
+                return await asyncio.wait_for(
+                    node.conn.call("flight_trace", {"trace_id": trace_id}),
+                    timeout=10)
+            except Exception as e:
+                return {"events": [], "sources": 0,
+                        "error": f"{node.node_id[:8]}: {type(e).__name__}: {e}"}
+
+        live = [
+            n for n in self.nodes.values()
+            if n.state == "ALIVE" and n.conn is not None and not n.conn.closed
+        ]
+        events: list[dict] = []
+        sources, errors = 0, []
+        for r in await asyncio.gather(*(one(n) for n in live)):
+            events = _merge_trace_events(events, r.get("events") or [])
+            sources += int(r.get("sources", 0))
+            if r.get("error"):
+                errors.append(r["error"])
+        own = _flight.recorder().events_for_trace(trace_id)
+        if own:  # head-process ring (controller events + driver spans)
+            events = _merge_trace_events(events, own)
+            sources += 1
+        t = self.traces.get(trace_id)
+        if t is not None:
+            events = _merge_trace_events(events, t["events"])
+        # Distinguish "evicted but recoverable" from "never existed": the
+        # trace_evicted events (satellite of this plane) carry the ids.
+        evicted = t is None and any(
+            ev.get("kind") == "trace_evicted" and ev.get("trace_id") == trace_id
+            for ev in self.events)
+        return {"events": events, "sources": sources, "indexed": t is not None,
+                "evicted": evicted, "errors": errors}
+
+    def handle_trace_autopsy(self, conn, p):
+        """Critical-path hop decomposition of one indexed trace: where did
+        the wall clock go (proxy queue / admission / wire / exec / drain)."""
+        t = self.traces.get(p["trace_id"])
+        if t is None:
+            return {"error": "trace not found (evicted or never indexed — "
+                             "try collect_flight_trace)"}
+        return _autopsy.autopsy(t["events"])
+
+    def handle_autopsy_summary(self, conn, p):
+        """Per-deployment "where does p99 go": autopsy every indexed serve
+        trace (bounded scan, newest first) and aggregate the hop shares."""
+        limit = int(p.get("limit", 200))
+        auts = []
+        for trace_id in reversed(list(self.traces)):
+            if len(auts) >= limit:
+                break
+            t = self.traces[trace_id]
+            if t["name"] != "serve.request":
+                continue
+            a = _autopsy.autopsy(t["events"])
+            if not a.get("error"):
+                auts.append(a)
+        return _autopsy.aggregate(auts)
+
     # -- metrics aggregation (ray.util.metrics equivalent pipeline) ------
     def handle_report_metrics(self, conn, p):
         self.metrics_by_reporter[p["reporter"]] = (time.monotonic(), p["series"])
@@ -1091,6 +1288,15 @@ class Controller:
             out.append(rec("events_dropped_total", "counter", self.task_events_dropped,
                            {"where": "controller_task_buffer"},
                            "aggregated task events lost to buffer trims"))
+        if self.flight_dumps_dropped:
+            out.append(rec("state.flight_dumps.dropped_total", "counter",
+                           self.flight_dumps_dropped, {},
+                           "flight dump records lost to the registry bound"))
+        # SLO plane: burn-rate + state gauges per objective, scraped from the
+        # same endpoint as everything else (no second metrics pipeline).
+        for g in self.slo_engine.gauges(ts):
+            g["tags"] = {**g["tags"], "reporter": "controller"}
+            out.append(g)
         return out
 
     async def _health_check_loop(self):

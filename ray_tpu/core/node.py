@@ -104,6 +104,8 @@ class NodeDaemon:
         # Chaos: an injected TPU-preemption notice fired for this node (the
         # daemon drains, then drops off the cluster after the grace window).
         self._preempted = False
+        # Flight dumps already reported to the controller (harvest dedup).
+        self._flight_reported: set[str] = set()
 
     def _spawn_bg(self, coro, name: str | None = None) -> asyncio.Task:
         """create_task with a strong reference held until completion. Every
@@ -276,6 +278,12 @@ class NodeDaemon:
             "chaos: TPU preemption notice for node %s (grace %.2fs)",
             self.node_id[:8], fault.delay_s,
         )
+        # Black box: record the notice and dump the ring NOW, while the
+        # grace window still exists — after it, this host is gone.
+        from ray_tpu.obs import flight as _flight
+
+        _flight.record("tpu.preempt", node=self.node_id[:12], grace_s=fault.delay_s)
+        _flight.dump("tpu.preempt", reason=f"node {self.node_id[:12]} preempted")
         try:
             await self.controller.call("drain_node", {"node_id": self.node_id})
         except Exception:
@@ -381,6 +389,10 @@ class NodeDaemon:
         env["RAYTPU_DAEMON_ADDR"] = self.address
         env["RAYTPU_NODE_IP"] = self.server.host  # workers bind/advertise the node's IP
         env["RAYTPU_STORE_PATH"] = self.store_path
+        # Flight-recorder dumps land NEXT TO the worker logs: a last-gasp
+        # dump (chaos kill, fatal crash) is harvested by _report_worker_died
+        # from the same directory tree an operator already checks.
+        env["RAYTPU_FLIGHT_DIR"] = os.path.join(self.log_dir, "flight")
         env["RAYTPU_NODE_ID"] = self.node_id
         env.setdefault("PYTHONPATH", "")
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -457,10 +469,14 @@ class NodeDaemon:
 
     async def _report_worker_died(self, record: WorkerRecord, reason: str):
         """Tell the controller (exactly once per worker) so actor FSMs advance
-        (reference: raylet NodeManager -> GcsActorManager::OnWorkerDead)."""
+        (reference: raylet NodeManager -> GcsActorManager::OnWorkerDead).
+        Also harvests the worker's last-gasp flight dumps (written
+        synchronously before os._exit, so the file beats the TCP close) and
+        reports each path so post-mortems surface on /api/events."""
         if record.death_reported:
             return
         record.death_reported = True
+        await self._report_flight_dumps(record, reason)
         try:
             await self.controller.call(
                 "worker_died",
@@ -468,6 +484,60 @@ class NodeDaemon:
             )
         except Exception:
             pass
+
+    async def _report_flight_dumps(self, record: WorkerRecord, reason: str):
+        """Harvest + report the worker's last-gasp dumps. Idempotent
+        (``_flight_reported``), so every death path can call it."""
+        for path in self._harvest_flight_dumps(record.worker_id):
+            logger.warning("harvested flight dump for dead worker %s: %s",
+                           record.worker_id[:8], path)
+            try:
+                await self.controller.notify("report_flight_dump", {
+                    "proc": record.worker_id[:12], "path": path,
+                    "trigger": "worker.death", "node_id": self.node_id,
+                    "reason": reason,
+                })
+            except Exception:
+                pass
+
+    def _harvest_flight_dumps(self, worker_id: str) -> list[str]:
+        """New (not-yet-reported) flight dumps this worker left on disk."""
+        fdir = os.path.join(self.log_dir, "flight")
+        try:
+            names = os.listdir(fdir)
+        except OSError:
+            return []
+        prefix = f"flight-{worker_id[:12]}-"
+        out = []
+        for n in sorted(names):
+            if n.startswith(prefix) and n.endswith(".jsonl"):
+                p = os.path.join(fdir, n)
+                if p not in self._flight_reported:
+                    self._flight_reported.add(p)
+                    out.append(p)
+        return out
+
+    async def handle_flight_trace(self, conn, p):
+        """Per-node leg of `raytpu trace export` reassembly: this daemon
+        process's own recorder plus every live worker's (fanned out the
+        memory_summary way). Dead/stalled workers are skipped — reassembly
+        is best-effort recovery, not a barrier."""
+        from ray_tpu.obs import flight as _flight
+
+        tid = p.get("trace_id", "")
+        events = list(_flight.recorder().events_for_trace(tid))
+        sources = 1
+        for w in list(self.workers.values()):
+            if w.conn is None or w.conn.closed or w.state == "DEAD":
+                continue
+            try:
+                r = await asyncio.wait_for(
+                    w.conn.call("flight_query", {"trace_id": tid}), timeout=5.0)
+                events.extend(r.get("events", []))
+                sources += 1
+            except Exception:
+                continue
+        return {"events": events, "sources": sources}
 
     async def _acquire_worker(self, renv: Optional[dict] = None) -> WorkerRecord:
         env_vars, pypath, cwd, env_hash, python_exe, container = await self._materialize_env(renv)
@@ -562,6 +632,12 @@ class NodeDaemon:
         # actors (max_restarts) would never leave ALIVE in the controller.
         if not already_dead and record.actor_ids:
             self._spawn_bg(self._report_worker_died(record, reason))
+        elif not already_dead:
+            # Plain task workers: the controller learns of the death through
+            # the caller's retry path, but the black box still needs
+            # harvesting — a "not reusable" lease return is how a chaos-
+            # killed worker gets reaped when it races the conn-close event.
+            self._spawn_bg(self._report_flight_dumps(record, reason))
 
     # -- object plane ---------------------------------------------------
     async def _peer(self, addr: str) -> rpc.Connection:

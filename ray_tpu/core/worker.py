@@ -54,6 +54,8 @@ from ray_tpu.core.object_store import MemoryStore, ObjectExistsError, ObjectStor
 from ray_tpu.core.serialization import RemoteError
 from ray_tpu.core import task_state as _ts
 from ray_tpu.core.task_spec import ActorSpec, TaskOptions, TaskSpec, scheduling_key
+from ray_tpu.obs import flight as _flight
+from ray_tpu.obs import health as _obs_health
 from ray_tpu.qos import context as _qos
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
@@ -709,8 +711,49 @@ class CoreWorker:
             self.daemon.on_close = _daemon_lost
         set_ref_hooks(self._on_ref_created, self._on_ref_removed)
         self._bg.append(asyncio.create_task(self._reaper_loop()))
+        # Observability plane: point the flight recorder at the ADOPTED
+        # config (a spawned worker's env defaults differ from the head's)
+        # and start the loop-lag probe on this process's IO loop.
+        self._setup_observability()
         if ready is not None:
             ready.set()
+
+    def _setup_observability(self):
+        cfg = self.config
+        _flight.configure(
+            proc_id=self.worker_id[:12],
+            dump_dir=os.environ.get("RAYTPU_FLIGHT_DIR", "") or cfg.obs_flight_dir,
+            capacity=cfg.obs_flight_ring,
+            storm_expiries=cfg.obs_storm_expiries,
+            storm_window_s=cfg.obs_storm_window_s,
+        )
+        loop = self.loop
+
+        def _report_dump(path: str, trigger: str):
+            # Dumps fire from arbitrary threads (qos hops, chaos sites):
+            # hop to the IO loop, then best-effort notify the controller so
+            # the path surfaces on /api/events. worker.death dumps skip this
+            # (the process exits immediately); the daemon harvest covers them.
+            def _post():
+                if not self._shutdown and self.controller is not None:
+                    self._spawn_bg(self.controller.notify("report_flight_dump", {
+                        "proc": self.worker_id[:12], "path": path,
+                        "trigger": trigger, "node_id": self.node_id,
+                    }), name="flight-dump-report")
+
+            try:
+                loop.call_soon_threadsafe(_post)
+            except RuntimeError:
+                pass  # loop already closed: the file on disk is the artifact
+
+        _flight.set_dump_hook(_report_dump)
+        if cfg.obs_loop_probe_interval_s > 0:
+            self._loop_probe = _obs_health.LoopLagProbe(
+                f"core-{self.mode}",
+                interval_s=cfg.obs_loop_probe_interval_s,
+                spike_s=cfg.obs_loop_spike_s,
+            )
+            self._bg.append(asyncio.create_task(self._loop_probe.run()))
 
     async def _controller_handshake(self, conn):
         for channel in self._pub_handlers:
@@ -800,6 +843,13 @@ class CoreWorker:
         if self._events_dropped:
             rec("events_dropped_total", "counter", self._events_dropped,
                 {"where": "worker"}, "task events lost to buffer trims before reporting")
+        fr = _flight.recorder()
+        if fr.events_evicted:
+            rec("flight.events_evicted", "counter", fr.events_evicted, {},
+                "flight-recorder ring evictions (oldest events displaced)")
+        if fr.dumps_written:
+            rec("flight.dumps_written", "counter", fr.dumps_written, {},
+                "flight-recorder dumps written by this process")
         if _STREAM_BATCH_HIST:
             # Streamed-item batch-size histogram (owner side): how many items
             # each generator_items frame carried — the live-cluster view of
@@ -965,7 +1015,12 @@ class CoreWorker:
     def _event(self, kind: str, **kw):
         # One timeline: the same clock as Span/event() in util/tracing, so
         # state-index timings and span timings interleave consistently.
-        self.task_events.append({"ts": _tracing.now(), "kind": kind, "worker": self.worker_id[:12], **kw})
+        ev = {"ts": _tracing.now(), "kind": kind, "worker": self.worker_id[:12], **kw}
+        self.task_events.append(ev)
+        # Tee into the process-local flight recorder: the reporter buffer
+        # above trims once shipped, the ring RETAINS (bounded) so a dump at
+        # death still holds the recent story. Same dict, no copy.
+        _flight.absorb(ev)
         if len(self.task_events) > self.config.event_buffer_size:
             trimmed = len(self.task_events) // 2
             # Only events the controller never saw are LOST; already-reported
@@ -1945,6 +2000,14 @@ class CoreWorker:
                         # reply ever leaves this process; the caller's retry
                         # path resubmits on a fresh worker.
                         logger.warning("chaos: worker.exec kill (task %s)", spec.task_id.hex()[:8])
+                        # Last-gasp black box: the ring currently holds this
+                        # task's exec_start and everything before it. Written
+                        # synchronously BEFORE os._exit (no atexit, no flush
+                        # window); the node daemon harvests the file alongside
+                        # the worker log when it reports the death.
+                        _flight.dump("worker.death",
+                                     reason=f"chaos worker.exec kill "
+                                            f"(task {spec.task_id.hex()[:8]})")
                         os._exit(1)
                     if fault.kind == "delay":
                         await asyncio.sleep(fault.delay_s)  # slow-executor stall
@@ -2721,7 +2784,20 @@ class CoreWorker:
             "events_reported": self._events_reported,
             "events_dropped": self._events_dropped,
             "tail": self.task_events[-tail:] if tail > 0 else [],
+            "flight": _flight.recorder().stats(),
         }
+
+    def handle_flight_dump(self, conn, p):
+        """Operator-requested black-box dump of THIS process (`raytpu debug
+        dump <worker>`): writes the ring and returns the path + stats."""
+        path = _flight.dump("manual", reason=p.get("reason", "rpc request"))
+        return {"path": path, **_flight.recorder().stats()}
+
+    def handle_flight_query(self, conn, p):
+        """Events this process's recorder still holds for one trace — the
+        per-worker leg of `raytpu trace export` reassembly (controller fans
+        out through the daemons, memory_summary-style)."""
+        return {"events": _flight.recorder().events_for_trace(p.get("trace_id", ""))}
 
 
 class ActorRuntime:

@@ -55,6 +55,15 @@ def main():
     init_task = loop.create_task(init())  # graftlint: disable=bg-strong-ref  run_forever below keeps this frame (and the ref) alive for the process lifetime
     try:
         loop.run_forever()
+    except BaseException as e:
+        # Fatal escape from the IO loop: leave a black box behind before the
+        # process unwinds (chaos kills dump at their own site; this covers
+        # everything else that takes the loop down). Harvested by the daemon
+        # with the worker log.
+        from ray_tpu.obs import flight
+
+        flight.dump("worker.death", reason=f"worker loop died: {type(e).__name__}: {e}")
+        raise
     finally:
         sys.exit(0)
 

@@ -28,10 +28,13 @@ h3{margin-bottom:0.1em}.muted{color:#777;font-size:0.85em}
 <a href=/api/events>events</a> · <a href=/api/checkpoints>checkpoints</a> ·
 <a href=/api/serve>serve</a> ·
 <a href=/api/metrics>metrics</a> · <a href=/api/traces>traces</a> ·
+<a href=/api/slo>slo</a> · <a href=/api/autopsy>autopsy</a> ·
+<a href=/api/flight>flight&nbsp;dumps</a> ·
 <a href=/api/jobs>jobs</a> · <a href=/metrics>prometheus</a> ·
 task filters: <code>/api/tasks?state=RUNNING&fn=NAME&node=ID&limit=50</code> ·
 profile a worker: <code>/api/profile?addr=IP:PORT&duration=2</code> ·
-trace search: <code>/api/traces?q=NAME</code>, one trace: <code>/api/traces?id=TRACE_ID</code></div>
+trace search: <code>/api/traces?q=NAME</code>, one trace: <code>/api/traces?id=TRACE_ID</code> ·
+critical path: <code>/api/traces?id=TRACE_ID&autopsy=1</code></div>
 <h3>Nodes</h3><table id=nodes></table>
 <h3>Actors</h3><table id=actors></table>
 <h3>Placement groups</h3><table id=pgs></table>
@@ -122,17 +125,33 @@ def _payload(path: str):
         return core._run(core.controller.call("get_events", {"limit": 1000, "with_stats": True}))
     if path.startswith("/api/traces"):
         # Recent traces; ?id=<trace_id> fetches one trace's events,
-        # ?q=<substr> filters by id prefix / root-span name.
+        # ?q=<substr> filters by id prefix / root-span name,
+        # ?id=<trace_id>&autopsy=1 decomposes the request's critical path.
         from urllib.parse import parse_qs, urlsplit
 
         q = parse_qs(urlsplit(path).query)
         trace_id = (q.get("id") or [""])[0]
         if trace_id:
+            if (q.get("autopsy") or ["0"])[0] not in ("", "0"):
+                return core._run(core.controller.call(
+                    "trace_autopsy", {"trace_id": trace_id}))
             return core._run(core.controller.call("get_trace", {"trace_id": trace_id}))
         return core._run(core.controller.call(
             "list_traces",
             {"limit": int((q.get("limit") or ["100"])[0]), "q": (q.get("q") or [""])[0]},
         ))
+    if path.startswith("/api/autopsy"):
+        # Per-deployment "where does p99 go" hop aggregation (obs/autopsy).
+        return core._run(core.controller.call("autopsy_summary", {}))
+    if path.startswith("/api/slo"):
+        # SLO burn-rate engine: objective status rows + the one-line rollup.
+        return {
+            "summary": core._run(core.controller.call("slo_summary", {})),
+            "objectives": core._run(core.controller.call("slo_status", {})),
+        }
+    if path.startswith("/api/flight"):
+        # Black-box dump registry: where every post-mortem file landed.
+        return core._run(core.controller.call("list_flight_dumps", {"limit": 50}))
     if path == "/api/serve":
         # Scale-plane view: per-deployment replica sets, demand estimates,
         # and the autoscaler's decision log (serve/controller.py

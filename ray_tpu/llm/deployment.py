@@ -68,6 +68,16 @@ class LLMServer:
         self._cond = threading.Condition()
         self._done: dict[str, dict] = {}
         self._ttft: dict[str, float] = {}
+        # TTFT distribution (serve.ttft_s): the SLO engine's third metric —
+        # an LLM objective on time-to-first-token reads this histogram the
+        # same way latency objectives read serve.request.latency_s.
+        from ray_tpu.util import metrics as _metrics
+
+        self._ttft_hist = _metrics.Histogram(
+            "serve.ttft_s", "time to first token per request",
+            boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30],
+            tag_keys=("deployment",),
+        ).bind(tags={"deployment": "llm"})
         # Per-request event streams for generate_stream subscribers.
         self._streams: dict[str, deque] = {}
         # Requests whose stream consumer disconnected; the loop thread aborts
@@ -123,6 +133,7 @@ class LLMServer:
                 for rid, ev in events.items():
                     if ev.get("ttft_s") is not None:
                         self._ttft[rid] = ev["ttft_s"]
+                        self._ttft_hist.observe(ev["ttft_s"])
                     stream = self._streams.get(rid)
                     if stream is not None:
                         stream.append(ev)

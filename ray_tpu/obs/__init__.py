@@ -1,0 +1,95 @@
+"""Production observability plane: flight recorder, SLO engine, autopsy.
+
+Three pillars on top of the raw signals PRs 2/4/9 already emit:
+
+  obs.flight   per-process black-box ring, dumped on death/invariant/
+               storm/preempt/manual triggers (closed TRIGGERS catalog)
+  obs.slo      declarative objectives + SRE multi-window burn-rate alerts,
+               evaluated on the controller from the merged reporter series
+  obs.autopsy  per-request critical-path hop decomposition + per-deployment
+               "where does p99 go" aggregation
+  obs.health   event-loop lag probe per process, thread dump on spikes
+
+Driver-facing helpers (`slo_register` et al) live here; the pillars are
+woven through worker/controller/serve/qos/chaos — see README "Production
+observability"."""
+from __future__ import annotations
+
+from ray_tpu.obs import autopsy, flight, health, slo  # noqa: F401
+
+
+def slo_register(spec: dict) -> dict:
+    """Register (or replace) one SLO objective on the controller. Spec
+    format: see obs/slo.py module docstring."""
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    return core._run(core.controller.call("slo_register", {"spec": spec}))
+
+
+def slo_unregister(name: str) -> bool:
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    return core._run(core.controller.call("slo_unregister", {"name": name}))
+
+
+def slo_status() -> list[dict]:
+    """Status rows for every registered objective (state, burn rates)."""
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    return core._run(core.controller.call("slo_status", {}))
+
+
+def trace_autopsy(trace_id: str) -> dict:
+    """Critical-path hop decomposition of one indexed trace."""
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    core._run(core._flush_task_events())
+    return core._run(core.controller.call("trace_autopsy", {"trace_id": trace_id}))
+
+
+def autopsy_summary() -> dict:
+    """Per-deployment aggregated hop breakdown across indexed traces."""
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    core._run(core._flush_task_events())
+    return core._run(core.controller.call("autopsy_summary", {}))
+
+
+def collect_flight_trace(trace_id: str) -> dict:
+    """Reassemble a FULL trace from every live per-process flight recorder
+    (plus whatever the controller index still holds) — works even after the
+    bounded trace index evicted it. Returns {events, sources, evicted}."""
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    core._run(core._flush_task_events())
+    res = core._run(core.controller.call(
+        "collect_flight_trace", {"trace_id": trace_id}))
+    # The driver's own recorder is not behind any daemon: merge it here.
+    local = flight.recorder().events_for_trace(trace_id)
+    if local:
+        res["events"] = _merge_events(res.get("events", []), local)
+        res["sources"] = res.get("sources", 0) + 1
+    return res
+
+
+def _merge_events(a: list[dict], b: list[dict]) -> list[dict]:
+    """Merge + dedup two event lists (same event can sit in the controller
+    index AND a recorder ring); identity is the stamped tuple every emitter
+    fills."""
+    seen = set()
+    out = []
+    for ev in list(a) + list(b):
+        key = (ev.get("ts"), ev.get("kind"), ev.get("worker", ""),
+               ev.get("span_id", ""), ev.get("task_id", ""), ev.get("name", ""))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(ev)
+    out.sort(key=lambda e: e.get("ts", 0.0))
+    return out

@@ -100,7 +100,7 @@ _cancel_ev: contextvars.ContextVar[Optional[threading.Event]] = contextvars.Cont
 _expired_total = _metrics.Counter(
     "serve.request.expired_total",
     "requests dropped because their deadline passed before the hop could serve them",
-    tag_keys=("hop",),
+    tag_keys=("hop", "class"),
 )
 # Tripwire for the core invariant "no deadline-expired request ever begins
 # executing": incremented ONLY if user code is about to run with a deadline
@@ -229,12 +229,19 @@ def parse_timeout_s(value) -> float:
 
 
 def raise_expired(hop: str, detail: str = "") -> None:
-    """THE expiry exit: count (``serve.request.expired_total{hop}``), drop a
-    point event onto the active trace, raise typed. Every hop that drops an
-    expired request goes through here — no silent expiry (machine-enforced
-    by graftlint rule ``counted-sheds``)."""
-    _expired_total.inc(tags={"hop": hop})
+    """THE expiry exit: count (``serve.request.expired_total{hop,class}``),
+    drop a point event onto the active trace, tee into the flight recorder
+    (whose deadline-storm detector dumps the ring when expiries burst), raise
+    typed. Every hop that drops an expired request goes through here — no
+    silent expiry (machine-enforced by graftlint rule ``counted-sheds``)."""
+    ctx = _ctx.get()
+    klass = ctx.priority if ctx is not None else DEFAULT_PRIORITY
+    _expired_total.inc(tags={"hop": hop, "class": klass})
     _tracing.event("qos.expired", hop=hop)
+    from ray_tpu.obs import flight as _flight
+
+    _flight.record("qos.expired", hop=hop, cls=klass, detail=detail)
+    _flight.note_expiry()
     raise DeadlineExceeded(
         f"request deadline exceeded at hop {hop!r}{': ' + detail if detail else ''}"
     )

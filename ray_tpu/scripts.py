@@ -655,6 +655,12 @@ def cmd_status(args) -> None:
     n_alive_actors = sum(1 for a in s["actors"].values() if a["state"] == "ALIVE")
     print(f"actors: {n_alive_actors} alive / {len(s['actors'])} total; "
           f"placement groups: {len(s['placement_groups'])}")
+    slo = core._run(core.controller.call("slo_summary", {}))
+    if slo.get("total"):
+        # One line, worst news first (details: `raytpu slo` / /api/slo).
+        alert = ",".join(slo["alert"]) or "-"
+        burning = ",".join(slo["burning"]) or "-"
+        print(f"slo: {slo['ok']}/{slo['total']} ok; alert: {alert}; burning: {burning}")
 
 
 def cmd_logs(args) -> None:

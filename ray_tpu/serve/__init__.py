@@ -11,11 +11,14 @@ from ray_tpu.serve.api import (
     get_app_handle,
     get_deployment_handle,
     http_port,
+    register_slo,
     rpc_port,
     run,
     shutdown,
+    slo_status,
     start,
     status,
+    unregister_slo,
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
@@ -49,9 +52,12 @@ __all__ = [
     "get_multiplexed_model_id",
     "http_port",
     "multiplexed",
+    "register_slo",
     "rpc_port",
     "run",
     "shutdown",
+    "slo_status",
     "start",
     "status",
+    "unregister_slo",
 ]

@@ -168,6 +168,32 @@ def status() -> dict:
     return rt.get(ctl.get_status.remote(), timeout=30)
 
 
+def register_slo(spec: dict) -> dict:
+    """Register (or replace) one SLO objective for a serve deployment —
+    latency p99 / availability / TTFT per deployment x priority class x
+    tenant. Spec format: obs/slo.py. Evaluated continuously on the cluster
+    controller; state shows up on /api/slo and `raytpu slo`."""
+    from ray_tpu import obs as _obs
+
+    res = _obs.slo_register(spec)
+    if not res.get("ok", False):
+        raise ValueError(res.get("error", "slo objective rejected"))
+    return res["objective"]
+
+
+def unregister_slo(name: str) -> bool:
+    from ray_tpu import obs as _obs
+
+    return _obs.slo_unregister(name)
+
+
+def slo_status() -> list:
+    """Status rows (state, burn rates) for every registered objective."""
+    from ray_tpu import obs as _obs
+
+    return _obs.slo_status()
+
+
 def http_port() -> int:
     ctl = _get_controller(create=False)
     port = rt.get(ctl.get_http_port.remote(), timeout=10)

@@ -278,8 +278,13 @@ class _ReplicaSet:
             while True:
                 with self.cond:
                     if w.admitted is not None:
-                        self._queue_delay.observe(
-                            time.time() - w.enqueued_at, tags={"class": klass})
+                        waited = time.time() - w.enqueued_at
+                        self._queue_delay.observe(waited, tags={"class": klass})
+                        # Autopsy anchor: the admission-wait hop of a traced
+                        # request (obs/autopsy.py). Free no-op when untraced.
+                        from ray_tpu.util import tracing as _tracing
+
+                        _tracing.event("qos.admitted", waited_s=waited, cls=klass)
                         return w.admitted
                     if w.expired:
                         break  # counted below, outside the lock

@@ -589,9 +589,14 @@ class ProxyActor:
         self._shed_total.inc(tags={"reason": "overload", "class": klass})
         if deployment:
             self._note_dep_qos(app, deployment, "sheds_total")
+        from ray_tpu.obs import flight as _flight
         from ray_tpu.util import tracing as _tracing
 
         _tracing.event("qos.shed", reason="overload", cls=klass)
+        # Black box: sheds are exactly what a post-mortem of an overload
+        # window needs, and untraced requests leave no span to carry them.
+        _flight.record("qos.shed", reason="overload", cls=klass,
+                       app=app, deployment=deployment)
         body = json.dumps({
             "error": "overloaded", "class": klass, "retry_after_s": retry_after,
         }).encode()

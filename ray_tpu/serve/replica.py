@@ -50,12 +50,23 @@ class Replica:
         # request latency histogram + request counter, tagged by app/deployment
         # so multi-app clusters stay separable on the Prometheus side.
         tags = {"app": app_name, "deployment": deployment_name}
-        self._latency = _metrics.Histogram(
+        self._latency_metric = _metrics.Histogram(
             "serve.request.latency_s",
             "serve request latency per deployment (seconds)",
             boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30],
-            tag_keys=("app", "deployment"),
-        ).bind(tags)
+            tag_keys=("app", "deployment", "cls", "tenant"),
+        )
+        self._latency_tags = tags
+        self._latency = self._latency_metric.bind(tags)
+        # Per-(class, tenant) bound series so SLO objectives can scope
+        # latency to a priority class / tenant (obs/slo.py). Each request
+        # lands in EXACTLY ONE series (qos-scoped when a RequestContext rode
+        # the call, the plain deployment series otherwise), so summing
+        # matching series never double-counts. Bounded: past the cap, new
+        # (class, tenant) pairs coarsen into the plain series — observations
+        # are never dropped, only their tags.
+        self._latency_by: dict[tuple, Any] = {}
+        self._LATENCY_SERIES_CAP = 64
         self._requests = _metrics.Counter(
             "serve.requests", "serve requests handled per deployment",
             tag_keys=("app", "deployment"),
@@ -70,6 +81,26 @@ class Replica:
             self._is_function = True
         if user_config is not None:
             self.reconfigure(user_config)
+
+    def _observe_latency(self, dt: float):
+        """Record one request's latency into its (class, tenant)-scoped
+        series when a RequestContext is active, else the plain deployment
+        series. Bound series are cached, so the steady-state cost matches
+        the old single bind (dict lookup + bisect)."""
+        ctx = _qos.current()
+        if ctx is None:
+            self._latency.observe(dt)
+            return
+        key = (ctx.priority, ctx.tenant)
+        bound = self._latency_by.get(key)
+        if bound is None:
+            if len(self._latency_by) >= self._LATENCY_SERIES_CAP:
+                self._latency.observe(dt)  # cardinality cap: coarsen, never drop
+                return
+            bound = self._latency_metric.bind(
+                {**self._latency_tags, "cls": ctx.priority, "tenant": ctx.tenant})
+            self._latency_by[key] = bound
+        bound.observe(dt)
 
     # -- data path ---------------------------------------------------------
     def _resolve_fn(self, method: str):
@@ -156,7 +187,7 @@ class Replica:
                 return self._resolve_fn(method)(*args, **kwargs)
         finally:
             self._leave_request(rid or "", qtoken)
-            self._latency.observe(time.perf_counter() - t0)
+            self._observe_latency(time.perf_counter() - t0)
             self._requests.inc()
             if token is not None:
                 from ray_tpu.serve.multiplex import _model_id_ctx
@@ -194,7 +225,7 @@ class Replica:
                 yield from out
         finally:
             self._leave_request(rid or "", qtoken)
-            self._latency.observe(time.perf_counter() - t0)
+            self._observe_latency(time.perf_counter() - t0)
             self._requests.inc()
             if token is not None:
                 _model_id_ctx.reset(token)
@@ -231,7 +262,7 @@ class Replica:
                     yield ("value", out)
         finally:
             self._leave_request(rid or "", qtoken)
-            self._latency.observe(time.perf_counter() - t0)
+            self._observe_latency(time.perf_counter() - t0)
             self._requests.inc()
             if token is not None:
                 _model_id_ctx.reset(token)

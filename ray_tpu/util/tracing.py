@@ -234,7 +234,14 @@ def export_timeline(path: str, limit: int = 20000) -> int:
     execution start on the executor's lane) so one request renders as a
     connected arrow chain across processes, and ``span`` events (the Span
     API) render as their own slices."""
-    events = get_task_events(limit)
+    return render_timeline(get_task_events(limit), path)
+
+
+def render_timeline(events: list[dict], path: str) -> int:
+    """THE event-list -> chrome-trace renderer: `export_timeline` (live
+    cluster), flight-recorder dumps (obs/flight.export_dump_timeline), and
+    `raytpu trace export` all render through this one path, so a black-box
+    post-mortem opens in the same tooling as a live timeline."""
     trace: list[dict] = []
     open_spans: dict[tuple, dict] = {}  # (worker, task_id) -> start event
     for ev in events:
@@ -313,6 +320,20 @@ def export_timeline(path: str, limit: int = 20000) -> int:
                 "ts": ts_us,
                 "pid": worker,
                 "tid": "control",
+                "args": {k: v for k, v in ev.items() if k not in ("ts", "kind", "worker")},
+            })
+        else:
+            # Everything else (chaos injections, qos shed/expiry, conn
+            # lifecycle, lag spikes — the flight recorder's extra feeds)
+            # renders as an instant tick so dumps lose nothing.
+            trace.append({
+                "name": kind or "event",
+                "cat": "event",
+                "ph": "i",
+                "s": "p",
+                "ts": ts_us,
+                "pid": worker,
+                "tid": "events",
                 "args": {k: v for k, v in ev.items() if k not in ("ts", "kind", "worker")},
             })
     with open(path, "w") as f:

@@ -164,6 +164,13 @@ def test_worker_kill_scenario_smoke():
     assert report["ok"], report
     assert report["invariants"]["no_stuck_tasks"]["ok"]
     assert report["details"]["retried_attempts"] >= 1
+    # Observability acceptance (ISSUE 15): the kill left a black box behind —
+    # the dying worker dumped its flight ring, the daemon harvested it, and
+    # the dump's autopsy attributes the in-flight task the kill interrupted.
+    fd = report["details"]["flight_dump"]
+    assert fd["trigger"] == "worker.death"
+    assert fd["events"] >= 1
+    assert fd["in_flight"], "post-mortem failed to attribute the killed task"
 
 
 @pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)

@@ -237,7 +237,7 @@ def test_dashboard_api_events_surfaces_drops(shared_ray, dash):
     assert "events" in payload
     assert set(payload["dropped"]) == {
         "controller_events", "task_events", "worker_events", "traces_evicted",
-        "tasks_evicted",
+        "tasks_evicted", "flight_dumps",
     }
 
 
