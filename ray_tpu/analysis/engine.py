@@ -210,7 +210,11 @@ def default_rules() -> list:
         LoopThreadRace,
         NoBlockingInAsync,
     )
-    from ray_tpu.analysis.rules_buffers import CountedSheds, CountedTrims
+    from ray_tpu.analysis.rules_buffers import (
+        CountedSheds,
+        CountedTransfers,
+        CountedTrims,
+    )
     from ray_tpu.analysis.rules_chaos import ChaosGate
     from ray_tpu.analysis.rules_fsm import FsmEmitter
     from ray_tpu.analysis.rules_security import MacBeforePickle
@@ -221,6 +225,7 @@ def default_rules() -> list:
         MacBeforePickle(),
         CountedTrims(),
         CountedSheds(),
+        CountedTransfers(),
         LoopThreadRace(),
         FsmEmitter(),
         ChaosGate(),

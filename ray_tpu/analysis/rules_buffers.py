@@ -1,4 +1,5 @@
-"""counted-trims + counted-sheds: nothing is discarded silently.
+"""counted-trims + counted-sheds + counted-transfers: nothing is discarded
+— or shipped — silently.
 
 counted-trims: every bounded eviction must increment a dropped/evicted
 counter — the "no silent caps" rule (PRs 2/4: every silently-trimmed buffer
@@ -15,6 +16,18 @@ request that vanished: under overload — exactly when you are debugging —
 the metrics would claim traffic that never existed. The sanctioned pattern
 is ``qos.raise_expired(hop)`` (which counts inside), so direct raises
 outside ray_tpu/qos/ are rare and must carry their own tally.
+
+counted-transfers closes the same gap on the SEND side of the wire: any
+function that moves bytes via a raw socket syscall (``os.sendfile``,
+``sock.sendmsg``, ``loop.sock_sendall``/``sock_sendfile``) bypasses the
+asyncio transport — and with it every place the byte counters normally
+live. A new fast path that forgets its ``*bytes*`` counter silently
+undercounts ``rpc.bytes``/``object.transfer.bytes``, and the dashboards
+then claim traffic that never happened (the wire-speed campaign's vectored
+sendmsg and fd->socket sendfile lanes are exactly such paths). Counted =
+the same function increments a ``*bytes*``-named counter (``+=`` or
+``.inc()``); helpers that a counting caller dispatches to carry a reasoned
+per-line suppression.
 
 Detected trim shapes:
   * slice deletes            ``del self.events[:trimmed]``
@@ -278,4 +291,83 @@ class CountedSheds(Rule):
                 f"{what} with no shed/expired/dropped counter incremented in "
                 "the same scope — count every rejected request (or go through "
                 "qos.raise_expired, which does)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# counted-transfers
+# ---------------------------------------------------------------------------
+
+# Raw socket send syscalls that bypass the asyncio transport (and therefore
+# every counter attached to the normal write path). Attribute names only:
+# the receiver object varies (os, a socket, the event loop).
+_TRANSFER_SYSCALLS = ("sendfile", "sendmsg", "sock_sendall", "sock_sendfile")
+
+
+def _is_bytes_counter_name(name: str) -> bool:
+    return "bytes" in name.lower()
+
+
+class _TransferRegion:
+    __slots__ = ("node", "sends", "counted")
+
+    def __init__(self, node):
+        self.node = node
+        self.sends: list = []  # ((line, end_line), what)
+        self.counted = False
+
+
+class CountedTransfers(Rule):
+    id = "counted-transfers"
+    explanation = (
+        "raw socket send syscall with no *bytes* counter incremented in the "
+        "same function — transport-bypassing sends must keep the byte "
+        "accounting honest"
+    )
+
+    def begin_file(self, ctx: FileContext) -> None:
+        self._module = _TransferRegion(None)
+        self._funcs: list = []
+
+    def _region(self) -> "_TransferRegion":
+        return self._funcs[-1] if self._funcs else self._module
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._funcs.append(_TransferRegion(node))
+            return
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            dn = dotted_name(node.target)
+            if dn and _is_bytes_counter_name(dn.rsplit(".", 1)[-1]):
+                self._region().counted = True
+            return
+        if not isinstance(node, ast.Call):
+            return
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        if fn.attr == "inc":
+            if _is_bytes_counter_name(dotted_name(fn.value)):
+                self._region().counted = True
+            return
+        if fn.attr in _TRANSFER_SYSCALLS:
+            self._region().sends.append((_span(node), f"{fn.attr}()"))
+
+    def leave(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and self._funcs:
+            self._flush(self._funcs.pop(), ctx)
+
+    def end_file(self, ctx: FileContext) -> None:
+        self._flush(self._module, ctx)
+
+    def _flush(self, region: "_TransferRegion", ctx: FileContext) -> None:
+        if region.counted:
+            return
+        for span, what in region.sends:
+            ctx.report(
+                self,
+                span,
+                f"{what} with no *bytes* counter incremented in the same "
+                "function — a transport-bypassing send that skips the byte "
+                "counters silently undercounts the wire",
             )

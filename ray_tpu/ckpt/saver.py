@@ -108,7 +108,10 @@ def snapshot_tree(tree: Any) -> dict[str, dict]:
     happens on the writer thread, off the step path, and the double
     buffer's queue bound caps live snapshots at two generations. Mutable
     numpy leaves are copied eagerly (the train loop may overwrite them in
-    place before the writer drains)."""
+    place before the writer drains). The same reference-is-the-snapshot
+    contract now runs end to end on the elastic plane too: session
+    keep_live(copy=False) + transfer.export_state(copy=False) park jax
+    leaves uncopied until the export/writer side materializes them."""
     out: dict[str, dict] = {}
     for path, leaf in _flatten(tree).items():
         shards_attr = getattr(leaf, "addressable_shards", None)

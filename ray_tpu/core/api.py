@@ -171,10 +171,14 @@ class Cluster:
         # _token_owned_by_live_cluster compares against this, not the live
         # (shared, mutable) Config field.
         self._session_token = self.config.auth_token
-        if self.config.auth_token:
-            from ray_tpu.core import rpc as _rpc
+        from ray_tpu.core import rpc as _rpc
 
+        if self.config.auth_token:
             _rpc.set_auth_token(self.config.auth_token)
+        # Transport knobs (vectored sends, MAC granularity, net shaping)
+        # install alongside the token: the head process serves raw frames
+        # too, so it must agree with the nodes it pushes this config to.
+        _rpc.apply_transport_config(self.config)
         self.host = _ServiceHost()
         self.controller = Controller(self.config, persist_path=persist_path)
         self.controller_addr = self.host.call(self.controller.start())
@@ -349,6 +353,9 @@ def init(
         from ray_tpu.core import rpc as _rpc
 
         _rpc.set_auth_token(cfg.auth_token)
+    from ray_tpu.core import rpc as _rpc_t
+
+    _rpc_t.apply_transport_config(cfg)
     if address is None:
         _global_cluster = Cluster(
             initialize_head=True,

@@ -67,6 +67,25 @@ class Config:
     # Per-chunk deadline; on expiry the source connection is dropped (it may
     # be mid-frame) and the chunk retries against an alternate replica.
     pull_chunk_timeout_s: float = 30.0
+    # Raw-lane MAC granularity on authenticated links: "window" MACs once
+    # per pull window (one control RPC + one HMAC finalize per
+    # pull_window_chunks run; tamper detection still covers every byte —
+    # a flipped bit anywhere fails the WHOLE window typed and it refetches
+    # per-chunk), "chunk" keeps the v3 per-4MiB-frame tag (finer retry
+    # unit, one RPC round trip per chunk). Peers that predate the window
+    # RPC are detected per connection and served per-chunk automatically.
+    raw_mac_granularity: str = "window"
+    # Vectored raw-lane sends (one sendmsg syscall per frame + direct
+    # socket writes that bypass the transport's buffer copy). Off = the
+    # pre-wire-speed sequential-write path; exists so bench_core can A/B
+    # the legacy wire shape in-process.
+    raw_vectored_send: bool = True
+    # Degraded-network shaping for the raw data lane, cluster-propagated:
+    # JSON {"rate_mb_s": X, "delay_ms": Y} token-bucket pacing applied at
+    # every raw-frame send (the socketpair-throttle fallback of the bench's
+    # netem profile — used when tc/CAP_NET_ADMIN is unavailable). Empty =
+    # wire speed.
+    net_shape_spec: str = ""
     # --- streaming generators (the token path of serve/LLM responses) ---
     # Bound on items buffered per stream between the producing generator and
     # the loop-side pump that ships them as batched generator_items frames.

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import hmac
 import logging
 import os
 import subprocess
@@ -231,6 +232,7 @@ class NodeDaemon:
             },
         )
         self.config = self.config.adopt_cluster(reply["config"])
+        rpc.apply_transport_config(self.config)
         if self.config.chaos_spec:
             # Arm the chaos plane with the cluster schedule (idempotent for
             # an identical spec, so controller-restart re-registration does
@@ -714,11 +716,23 @@ class NodeDaemon:
         ONE open per transfer session instead of a path resolve + open per
         chunk; pread needs no seek state so concurrent chunks can share the
         fd. The reaper closes fds idle >60s; delete closes eagerly."""
-        if not self.store.spill_dir:
-            return None
         fault = _chaos.maybe_inject("node.spill.pread", oid=oid.hex()[:16])
         if fault is not None and fault.kind == "error":
             return None  # unreadable spill file: callers fail loud (KeyError)
+        fd = self._spill_fd(oid)
+        if fd is None:
+            return None
+        try:
+            return os.pread(fd, length, offset)
+        except OSError:
+            return None
+
+    def _spill_fd(self, oid: ObjectID) -> int | None:
+        """The cached read fd for a spilled object (opening it on first use),
+        or None. Shared by _spilled_pread and the sendfile serve path — one
+        open per transfer session either way."""
+        if not self.store.spill_dir:
+            return None
         key = oid.binary()
         ent = self._spill_fds.get(key)
         if ent is None:
@@ -728,10 +742,7 @@ class NodeDaemon:
                 return None
             ent = self._spill_fds[key] = [fd, 0.0]
         ent[1] = time.monotonic()
-        try:
-            return os.pread(ent[0], length, offset)
-        except OSError:
-            return None
+        return ent[0]
 
     def _close_spill_fd(self, oid: ObjectID):
         ent = self._spill_fds.pop(oid.binary(), None)
@@ -741,13 +752,11 @@ class NodeDaemon:
             except OSError:
                 pass
 
-    async def handle_read_object_chunk_raw(self, conn, p):
-        """Serve one chunk on the raw lane: the payload is an arena
-        memoryview slice (or a spilled pread) written straight to the wire —
-        no bytes() copy, no pickle (reference: ObjectManager chunked Push).
-        The reply is a tiny ack that can coalesce with other replies."""
-        oid = ObjectID(p["oid"])
-        offset, length = p["offset"], p["length"]
+    def _serve_chunk_chaos(self, oid: ObjectID, offset: int):
+        """The chunk-serve fault gate, shared by the per-chunk and window
+        serve handlers (graftlint's chaos-gate rule wants ONE literal
+        ``node.chunk.serve`` injection point tree-wide, and the two handlers
+        must fail identically under it)."""
         fault = _chaos.maybe_inject("node.chunk.serve", oid=oid.hex()[:16])
         if fault is not None:
             if fault.kind == "evict":
@@ -768,6 +777,15 @@ class NodeDaemon:
                 raise KeyError(f"object {oid.hex()} not in store (chaos-evicted)")
             if fault.kind == "error":
                 raise fault.error(f"chunk {oid.hex()[:10]}+{offset}")
+
+    async def handle_read_object_chunk_raw(self, conn, p):
+        """Serve one chunk on the raw lane: the payload is an arena
+        memoryview slice (or a spilled pread) written straight to the wire —
+        no bytes() copy, no pickle (reference: ObjectManager chunked Push).
+        The reply is a tiny ack that can coalesce with other replies."""
+        oid = ObjectID(p["oid"])
+        offset, length = p["offset"], p["length"]
+        self._serve_chunk_chaos(oid, offset)
         view = self.store.get(oid)
         if view is None and self._restore_local(oid):  # restore once, stream from arena
             view = self.store.get(oid)
@@ -794,6 +812,55 @@ class NodeDaemon:
         finally:
             view.release()
             self.store.release(oid)
+
+    async def handle_read_object_window_raw(self, conn, p):
+        """Serve a RUN of chunks (a whole pull window) on the raw lane with
+        ONE control RPC and — on authenticated links — ONE MAC tag for the
+        run (window mode, see rpc.raw_window_hasher): chunk i of the run is
+        a NOPTAG raw frame keyed base||i, payload bytes streamed into a
+        shared window HMAC whose tag returns in this handler's authenticated
+        envelope reply. The puller hashes the same bytes as they land and
+        compares — tamper anywhere in the window fails the WHOLE window
+        typed and it refetches per-chunk. With auth off there is no MAC
+        either way, and a spilled run goes fd->socket via os.sendfile (the
+        payload never enters userspace)."""
+        oid = ObjectID(p["oid"])
+        offset, length, chunk = p["offset"], p["length"], p["chunk"]
+        base = p["key"]
+        self._serve_chunk_chaos(oid, offset)
+        hasher = rpc.raw_window_hasher() if rpc.get_auth_token() else None
+        view = self.store.get(oid)
+        if view is None and self._restore_local(oid):  # restore once, stream from arena
+            view = self.store.get(oid)
+        try:
+            pos, end, i = offset, offset + length, 0
+            while pos < end:
+                cln = min(chunk, end - pos)
+                key = base + i.to_bytes(4, "little")
+                if view is not None:
+                    await conn.send_raw(key, view[pos : pos + cln], hasher=hasher)
+                elif hasher is None and (fd := self._spill_fd(oid)) is not None:
+                    await conn.send_raw_file(key, fd, pos, cln)
+                else:
+                    data = self._spilled_pread(oid, pos, cln)
+                    if data is None:
+                        raise KeyError(f"object {oid.hex()} not in store")
+                    if len(data) != cln:
+                        # Same fail-loud contract as the per-chunk handler:
+                        # surface "spill file truncated", don't let the
+                        # window tag mismatch bury it.
+                        raise OSError(
+                            f"truncated spill read for {oid.hex()}: wanted {cln} at +{pos}, got {len(data)}"
+                        )
+                    await conn.send_raw(key, data, hasher=hasher)
+                self.pull_manager.bytes_out += cln
+                pos += cln
+                i += 1
+        finally:
+            if view is not None:
+                view.release()
+                self.store.release(oid)
+        return {"ok": True, "tag": hasher.digest()[: rpc.FRAME_TAG_LEN] if hasher is not None else b""}
 
     def handle_read_object_chunk(self, conn, p):
         """Legacy pickled chunk read (pre-v3 pull path; kept for tooling and
@@ -1074,7 +1141,24 @@ class PullManager:
         live = [loc for loc, sz in probed if sz == size]
         chunk = cfg.pull_chunk_size
         nchunks = (size + chunk - 1) // chunk or 1
-        pending = collections.deque(range(nchunks))
+        # Window mode: chunks group into runs of up to pull_window_chunks,
+        # each fetched with ONE control RPC (and, with auth on, ONE MAC tag)
+        # via read_object_window_raw — see _fetch_window. Chunk mode (and
+        # single-chunk runs) keeps the v3 per-chunk shape. Runs stripe
+        # across sources exactly like chunks did, and a run never admits
+        # more than the inflight-byte budget in one acquisition (the
+        # admission cap must bound window mode exactly as it bounds chunks).
+        run_chunks = 1
+        if getattr(cfg, "raw_mac_granularity", "window") == "window":
+            run_chunks = max(1, cfg.pull_window_chunks)
+            run_chunks = min(run_chunks, max(1, cfg.max_inflight_pull_bytes // chunk))
+        runs: list[tuple[int, int]] = []
+        i = 0
+        while i < nchunks:
+            k = min(run_chunks, nchunks - i)
+            runs.append((i, k))
+            i += k
+        pending = collections.deque(runs)
         retried_before = self.chunks_retried
         stop = False
         buf = None
@@ -1088,12 +1172,15 @@ class PullManager:
             async def window_worker():
                 nonlocal stop
                 while pending and not stop:
-                    i = pending.popleft()
-                    off = i * chunk
-                    ln = min(chunk, size - off)
+                    ri, rk = pending.popleft()
+                    off = ri * chunk
+                    ln = min(rk * chunk, size - off)
                     await self._acquire_bytes(ln)
                     try:
-                        await self._fetch_chunk(oid, buf, off, ln, live, i)
+                        if rk == 1:
+                            await self._fetch_chunk(oid, buf, off, ln, live, ri)
+                        else:
+                            await self._fetch_window(oid, buf, off, ln, chunk, live, ri)
                         self.bytes_in += ln
                     except Exception:
                         stop = True
@@ -1107,7 +1194,7 @@ class PullManager:
             # bg-strong-ref story simple and names the tasks for leak debug).
             workers = [
                 d._spawn_bg(window_worker(), name="pull-window")
-                for _ in range(min(max(1, cfg.pull_window_chunks), nchunks))
+                for _ in range(min(max(1, cfg.pull_window_chunks), len(runs)))
             ]
             results = await asyncio.gather(*workers, return_exceptions=True)
             errs = [r for r in results if isinstance(r, BaseException)]
@@ -1138,12 +1225,118 @@ class PullManager:
             "chunks": nchunks,
             "chunks_retried": self.chunks_retried - retried_before,
             "mb_s": round(mb_s, 1),
+            "mode": "window" if run_chunks > 1 else "chunk",
         }
         _tracing.event("object.pull.done", size=size, mb_s=round(mb_s, 1))
         await d.controller.notify(
             "report_object", {"oid": oid.binary(), "node_id": d.node_id, "size": size}
         )
         return True
+
+    def _pull_source_chaos(self, src: dict):
+        """The pull-source fault gate, shared by the window and per-chunk
+        fetch paths (ONE literal ``node.pull.source`` injection point —
+        chaos-gate's uniqueness contract): a simulated source death spends
+        that source's failure budget and hard-drops its connection exactly
+        like a real mid-transfer failure."""
+        pull_fault = _chaos.maybe_inject("node.pull.source", source=src["node_id"][:12])
+        if pull_fault is not None and pull_fault.kind == "error":
+            raise pull_fault.error(f"source {src['node_id'][:8]}")
+
+    async def _fetch_window(self, oid: ObjectID, buf, off: int, ln: int, chunk: int, sources: list, idx: int):
+        """Fetch a run of chunks with ONE read_object_window_raw RPC.
+        Chunk i of the run lands at its own offset (keyed base||i) and, on
+        authenticated links, streams into a shared window HMAC compared
+        against the tag the serve reply carries — tamper ANYWHERE in the run
+        fails the whole window typed, the source connection is hard-dropped
+        (it may be mid-frame), and the run refetches per-chunk with its own
+        failover budget. A peer without the window handler ("no handler"
+        RpcError — an older build) is remembered on its connection and
+        served per-chunk from then on (capability negotiation by first
+        use)."""
+        d = self.daemon
+        cfg = d.config
+        nchunks = (ln + chunk - 1) // chunk
+        # One deadline over the whole run: proportional to the per-chunk
+        # deadline so degraded links don't time out a window that would have
+        # passed chunk by chunk.
+        timeout = cfg.pull_chunk_timeout_s * max(1.0, nchunks / 2)
+        n = len(sources)
+        for attempt in range(n):
+            src = sources[(idx + attempt) % n]
+            if src.get("dead"):
+                continue
+            conn = None
+            try:
+                conn = await d._peer(src["address"])
+            except Exception:
+                continue
+            if conn.meta.get("no_window_raw"):
+                continue  # known pre-window peer: per-chunk fallback below
+            base = os.urandom(12)
+            hasher = rpc.raw_window_hasher() if rpc.get_auth_token() else None
+            keys = []
+            futs = []
+            try:
+                self._pull_source_chaos(src)
+                for i in range(nchunks):
+                    coff = off + i * chunk
+                    cln = min(chunk, off + ln - coff)
+                    key = base + i.to_bytes(4, "little")
+                    keys.append(key)
+                    futs.append(conn.expect_raw(key, buf[coff : coff + cln], hasher))
+                try:
+                    ack, *landed = await asyncio.wait_for(
+                        asyncio.gather(
+                            conn.call(
+                                "read_object_window_raw",
+                                {"oid": oid.binary(), "offset": off, "length": ln,
+                                 "chunk": chunk, "key": base},
+                            ),
+                            *futs,
+                        ),
+                        timeout,
+                    )
+                finally:
+                    for key in keys:
+                        conn.unexpect_raw(key)
+                if not ack or not ack.get("ok") or not all(landed):
+                    raise rpc.RpcError("window transfer failed")
+                if hasher is not None and not hmac.compare_digest(
+                    ack.get("tag", b""), hasher.digest()[: rpc.FRAME_TAG_LEN]
+                ):
+                    raise rpc.RawWindowTamperError(
+                        f"window MAC mismatch for {oid.hex()[:10]}+{off} from {src['node_id'][:8]}"
+                    )
+                return
+            except Exception as e:
+                if isinstance(e, rpc.RpcError) and "no handler" in str(e):
+                    # Older peer without the window RPC: negotiate down to
+                    # per-chunk for this connection's lifetime, silently.
+                    conn.meta["no_window_raw"] = True
+                    break
+                self.chunks_retried += nchunks
+                _tracing.event(
+                    "object.pull.window_retry",
+                    oid=oid.hex()[:16], offset=off, source=src["node_id"][:8],
+                    error=f"{type(e).__name__}: {e}"[:120],
+                )
+                logger.warning(
+                    "window %s+%d of %s from %s failed (%s: %s); refetching per-chunk",
+                    off, ln, oid.hex()[:10], src["node_id"][:8], type(e).__name__, e,
+                )
+                # The source may be mid-frame into our buffer: hard-drop its
+                # connection so a dead writer can't race the per-chunk retry
+                # on the same region (same contract as _fetch_chunk).
+                if conn is not None and d._peer_conns.get(src["address"]) is conn:
+                    await d._drop_peer(src["address"], conn)
+                break
+        # Per-chunk fallback: every chunk of the run through the v3 path
+        # with its own striping + failover budget.
+        for i in range(nchunks):
+            coff = off + i * chunk
+            cln = min(chunk, off + ln - coff)
+            await self._fetch_chunk(oid, buf, coff, cln, sources, idx + i)
 
     async def _fetch_chunk(self, oid: ObjectID, buf, off: int, ln: int, sources: list, idx: int):
         """Fetch one chunk, striping the initial source by chunk index and
@@ -1168,12 +1361,7 @@ class PullManager:
             conn = None
             try:
                 conn = await d._peer(src["address"])
-                pull_fault = _chaos.maybe_inject("node.pull.source", source=src["node_id"][:12])
-                if pull_fault is not None and pull_fault.kind == "error":
-                    # Simulated source death mid-object: spends this source's
-                    # failure budget and hard-drops its connection below,
-                    # exactly like a real mid-chunk failure.
-                    raise pull_fault.error(f"source {src['node_id'][:8]}")
+                self._pull_source_chaos(src)
                 key = os.urandom(12)
                 fut = conn.expect_raw(key, buf[off : off + ln])
                 try:

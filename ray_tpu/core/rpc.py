@@ -92,6 +92,119 @@ _MAX_RAW_HDR = 1 << 16
 # Domain separation for the raw header MAC (a replayed envelope tag must not
 # verify as a raw header tag).
 _RAW_HDR_DOMAIN = b"raytpu-raw-hdr:"
+# Domain separation for the per-window payload MAC (window mode, see
+# raw_window_hasher): a window tag must never verify as a per-chunk ptag or
+# an envelope tag.
+_RAW_WIN_DOMAIN = b"raytpu-raw-win:"
+# Raw-frame header flag bits (third element of the header tuple; a 2-tuple
+# header means flags == 0 — v3 per-chunk frames stay parseable verbatim).
+# NOPTAG: no trailing per-chunk ptag; the payload is covered by an
+# out-of-band window MAC instead (returned in the serve RPC's authenticated
+# envelope reply and checked by the puller over the whole window).
+_RAW_F_NOPTAG = 1
+
+# -- raw-lane tuning (installed cluster-wide via apply_transport_config) ----
+# Vectored sends: ship a whole raw frame (prefix + payload slices + tag) as
+# ONE sendmsg syscall straight on the socket when the transport buffer is
+# empty, instead of three transport writes (each of which memcpys any unsent
+# remainder into the transport's buffer on this interpreter). Off = the
+# pre-wire-speed sequential-write shape, kept as a bench A/B arm.
+_VECTORED_SEND = True
+# "window" | "chunk": whether pullers ask for whole MAC-per-window runs
+# (read_object_window_raw) or per-chunk ptag frames. Transport-level default;
+# the PullManager consults this via raw_lane_config().
+_MAC_GRANULARITY = "window"
+# Degraded-network shaping (token bucket + fixed delay) applied to every
+# raw-lane frame send. 0/0 = wire speed. This is the in-process stand-in for
+# a netem-shaped loopback when tc/CAP_NET_ADMIN is unavailable.
+_NET_RATE_BPS = 0.0
+_NET_DELAY_S = 0.0
+_NET_BURST = 1 << 20  # bucket depth: one part-sized burst
+_net_tokens = 0.0
+_net_stamp = 0.0
+# Socket buffer target for peer links: the kernel default (~208 KiB rmem)
+# wakes the receiving loop ~64 times per 8 MiB object; 4 MiB buffers let a
+# whole chunk land per wakeup, which on a 1-core host is most of the win.
+_SOCK_BUF = 4 << 20
+
+
+def configure_raw_lane(*, vectored: bool | None = None, mac_granularity: str | None = None):
+    """Install raw-lane behavior knobs for this process (idempotent; called
+    at every config-adoption site so head, daemons and workers agree)."""
+    global _VECTORED_SEND, _MAC_GRANULARITY
+    if vectored is not None:
+        _VECTORED_SEND = bool(vectored)
+    if mac_granularity is not None:
+        if mac_granularity not in ("window", "chunk"):
+            raise ValueError(f"raw_mac_granularity must be 'window' or 'chunk', got {mac_granularity!r}")
+        _MAC_GRANULARITY = mac_granularity
+
+
+def raw_lane_config() -> dict:
+    return {
+        "vectored": _VECTORED_SEND,
+        "mac_granularity": _MAC_GRANULARITY,
+        "net_rate_bps": _NET_RATE_BPS,
+        "net_delay_s": _NET_DELAY_S,
+    }
+
+
+def set_net_shape(spec: str | None):
+    """Install (or clear, with empty spec) degraded-network shaping for the
+    raw lane from a JSON ``{"rate_mb_s": X, "delay_ms": Y}`` spec. Applied
+    at send time by _net_pace; both sides of a link shape independently so
+    a loopback A/B pays the configured rate once per direction."""
+    global _NET_RATE_BPS, _NET_DELAY_S, _net_tokens, _net_stamp
+    if not spec:
+        _NET_RATE_BPS = 0.0
+        _NET_DELAY_S = 0.0
+        return
+    import json
+
+    shape = json.loads(spec)
+    _NET_RATE_BPS = float(shape.get("rate_mb_s", 0.0)) * 1e6
+    _NET_DELAY_S = float(shape.get("delay_ms", 0.0)) / 1e3
+    _net_tokens = float(_NET_BURST)
+    _net_stamp = time.monotonic()
+
+
+async def _net_pace(nbytes: int):
+    """Token-bucket pacing + fixed one-way delay for a raw frame of
+    ``nbytes``. No-op (no await) when shaping is off."""
+    global _net_tokens, _net_stamp
+    if _NET_DELAY_S > 0.0:
+        await asyncio.sleep(_NET_DELAY_S)
+    if _NET_RATE_BPS <= 0.0:
+        return
+    now = time.monotonic()
+    _net_tokens = min(float(_NET_BURST), _net_tokens + (now - _net_stamp) * _NET_RATE_BPS)
+    _net_stamp = now
+    _net_tokens -= nbytes
+    if _net_tokens < 0.0:
+        await asyncio.sleep(-_net_tokens / _NET_RATE_BPS)
+
+
+def apply_transport_config(cfg) -> None:
+    """One-call install of the transport knobs a Config carries
+    (raw_vectored_send, raw_mac_granularity, net_shape_spec) — the single
+    home for config->transport wiring so every adoption site (head init,
+    node/worker adopt_cluster, controller start) stays in lockstep."""
+    configure_raw_lane(
+        vectored=getattr(cfg, "raw_vectored_send", True),
+        mac_granularity=getattr(cfg, "raw_mac_granularity", "window"),
+    )
+    set_net_shape(getattr(cfg, "net_shape_spec", "") or "")
+
+
+def _tune_peer_socket(sock) -> None:
+    """Large SO_SNDBUF/SO_RCVBUF on peer links (both dial and accept side):
+    bulk raw-lane frames are 4 MiB, and a receive buffer that holds a whole
+    chunk turns ~64 read-loop wakeups per 8 MiB object into a handful."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, _SOCK_BUF)
+    except OSError:
+        pass  # platform cap (wmem_max) applies silently; best effort
 
 
 def _raw_payload_hasher():
@@ -110,6 +223,22 @@ def _raw_payload_hasher():
     per chunk outweighed the second-core overlap. Revisit only with a
     multi-host bench in hand."""
     return hmac.new(_frame_key, None, hashlib.sha256)
+
+
+def raw_window_hasher():
+    """Streaming MAC for a whole pull window (window mode): HMAC-SHA256 over
+    the domain prefix + the window's payload bytes in send order. Both sides
+    hash every byte (tamper detection still covers the full payload — the
+    saving vs per-chunk ptags is finalize/compare/control-RPC overhead and
+    the 16-byte trailer per 4 MiB frame, not hashing), the server returns
+    the tag in its authenticated envelope reply, and the puller compares
+    after the last chunk of the window lands. Chunk headers stay
+    individually keyed-BLAKE2b'd (htag), so lengths/keys/ordering are
+    authenticated per frame; the concatenated-payload MAC then pins the
+    bytes to that authenticated sequence."""
+    h = hmac.new(_frame_key, None, hashlib.sha256)
+    h.update(_RAW_WIN_DOMAIN)
+    return h
 # Sanity cap on a declared frame length: readexactly buffers the whole frame
 # BEFORE the auth check can reject the peer, so an untrusted header must not
 # be able to demand unbounded memory.
@@ -271,6 +400,13 @@ class ConnectionLost(RpcError):
     pass
 
 
+class RawWindowTamperError(RpcError):
+    """Window-mode MAC mismatch: some byte of a pull window's payload was
+    tampered in flight. Typed so callers (and chaos assertions) can tell
+    integrity failure from transport failure; the whole window is refetched
+    per-chunk after the offending peer is dropped."""
+
+
 def parse_addr(addr: str):
     if addr.startswith("unix:"):
         return ("unix", addr[5:])
@@ -300,9 +436,18 @@ class Connection:
         # intermediate bytes) and resolves the future.
         self._raw_expect: dict[bytes, list] = {}
         self._raw_sock = None  # lazily dup'd fd for zero-copy sock_recv_into
+        self._raw_send_sock = None  # lazily dup'd fd for vectored/sendfile sends
         # Set once the first backlogged send_raw zeroes the transport's
         # write-buffer limits (drain == buffer fully empty; see send_raw).
         self._raw_zero_limits = False
+        # Serializes raw-lane senders (vectored sends await mid-frame, so
+        # two concurrent send_raw calls could interleave frame parts).
+        self._raw_send_lock = asyncio.Lock()
+        # True while a vectored raw send owns the socket directly (bytes in
+        # flight that the transport doesn't know about): envelope flushes
+        # must not writer.write() underneath it or their bytes would land
+        # mid-raw-frame. _flush_out defers; release reschedules it.
+        self._tx_hold = False
         # Strong refs to in-flight dispatch tasks: asyncio tracks tasks
         # weakly, and a gc cycle landing mid-await kills an unreferenced
         # task with GeneratorExit. Handlers can run for minutes (a
@@ -330,6 +475,11 @@ class Connection:
         self._flush_scheduled = False
         if self._closed or not self._out:
             self._out.clear()
+            return
+        if self._tx_hold:
+            # A vectored raw send owns the socket; writing now would splice
+            # envelope bytes into the middle of its frame. The hold's
+            # release reschedules this flush.
             return
         msgs = self._out
         self._out = []
@@ -467,16 +617,23 @@ class Connection:
     # corrupt data at worst, never execute code — the header is the lane's
     # code-execution surface and keeps the strict verify-before-pickle rule.
 
-    def expect_raw(self, key: bytes, dest: memoryview) -> "asyncio.Future":
+    def expect_raw(self, key: bytes, dest: memoryview, hasher=None) -> "asyncio.Future":
         """Register ``dest`` as the landing buffer for an incoming raw frame
         keyed ``key``; returns a future resolving True once the payload has
         fully landed (and, with auth enabled, verified). The payload length
         must equal len(dest) or the frame is discarded and the future
-        resolves False. Unregister with unexpect_raw on timeout."""
+        resolves False. Unregister with unexpect_raw on timeout.
+
+        ``hasher`` (window mode): a shared raw_window_hasher() updated with
+        this frame's payload bytes as they land, INSTEAD of a per-chunk ptag
+        (the sender marks the frame NOPTAG). The caller compares the final
+        digest against the serve RPC's window tag after the whole window
+        lands — until then the bytes are unverified and must stay in a
+        transfer-private buffer."""
         if self._closed:
             raise ConnectionLost(f"connection to {self.peer_name} closed")
         fut = self._loop.create_future()
-        self._raw_expect[key] = [dest, fut]
+        self._raw_expect[key] = [dest, fut, hasher]
         return fut
 
     def unexpect_raw(self, key: bytes):
@@ -484,23 +641,44 @@ class Connection:
         if entry is not None and not entry[1].done():
             entry[1].set_result(False)
 
-    async def send_raw(self, key: bytes, payload) -> None:
-        """Send one raw-lane frame. ``payload`` is bytes/memoryview; it is
-        written to the transport as-is — no pickle, no bytes() copy. Awaits
-        transport drain (bulk-lane backpressure)."""
-        global _SEND_BYTES, _RAW_SEND_BYTES
-        if self._closed:
-            raise ConnectionLost(f"connection to {self.peer_name} closed")
+    async def _raw_send_fault(self) -> bool:
+        """The raw-lane send fault gate, shared by send_raw and
+        send_raw_file (ONE literal ``rpc.raw.send`` injection point —
+        chaos-gate's uniqueness contract — and both senders must fail
+        identically under it). True = drop this frame."""
         fault = _chaos.maybe_inject("rpc.raw.send", peer=self.peer_name)
         if fault is not None:
             if fault.kind == "drop":
-                return  # chunk never lands; the puller's deadline fails it over
+                return True
             if fault.kind == "stall":
                 await asyncio.sleep(fault.delay_s)
-        payload = memoryview(payload)
-        hdr = pickle.dumps((key, len(payload)), protocol=5)
-        taglen = 2 * _TAG_LEN if _frame_key else 0
-        ln = 1 + taglen + 4 + len(hdr) + len(payload)
+        return False
+
+    async def send_raw(self, key: bytes, payload, hasher=None) -> None:
+        """Send one raw-lane frame. ``payload`` is bytes/memoryview OR a
+        list/tuple of them (a multi-part frame: header + every slice ship as
+        one vectored syscall); payload bytes are written to the socket
+        as-is — no pickle, no bytes() copy, no join. Awaits transport drain
+        (bulk-lane backpressure).
+
+        ``hasher`` (window mode, auth on): a shared raw_window_hasher()
+        updated with the payload; the frame is sent NOPTAG and the caller
+        ships hasher.digest() out of band (authenticated envelope reply).
+        Without it, an authenticated frame carries the per-chunk ptag."""
+        global _SEND_BYTES, _RAW_SEND_BYTES
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.peer_name} closed")
+        if await self._raw_send_fault():
+            return  # chunk never lands; the puller's deadline fails it over
+        if isinstance(payload, (list, tuple)):
+            parts = [p if isinstance(p, memoryview) else memoryview(p) for p in payload]
+        else:
+            parts = [payload if isinstance(payload, memoryview) else memoryview(payload)]
+        plen = sum(len(p) for p in parts)
+        noptag = hasher is not None and bool(_frame_key)
+        hdr = pickle.dumps((key, plen, _RAW_F_NOPTAG) if noptag else (key, plen), protocol=5)
+        taglen = (_TAG_LEN if noptag else 2 * _TAG_LEN) if _frame_key else 0
+        ln = 1 + taglen + 4 + len(hdr) + plen
         prefix = bytearray(ln.to_bytes(_HDR, "little"))
         prefix += _RAW
         ptag = b""
@@ -508,19 +686,36 @@ class Connection:
             prefix += hashlib.blake2b(
                 _RAW_HDR_DOMAIN + hdr, key=_frame_key, digest_size=_TAG_LEN
             ).digest()
-            h = _raw_payload_hasher()
-            h.update(hdr)
-            h.update(payload)
-            ptag = h.digest()[:_TAG_LEN]
+            if noptag:
+                for p in parts:
+                    hasher.update(p)
+            else:
+                h = _raw_payload_hasher()
+                h.update(hdr)
+                for p in parts:
+                    h.update(p)
+                ptag = h.digest()[:_TAG_LEN]
         prefix += len(hdr).to_bytes(4, "little")
         prefix += hdr
         _SEND_BYTES += ln + _HDR
         _RAW_SEND_BYTES += ln + _HDR
+        await _net_pace(ln + _HDR)
+        bufs = [prefix, *parts]
+        if ptag:
+            bufs.append(ptag)
+        if _VECTORED_SEND:
+            sock = self.writer.get_extra_info("socket")
+            if sock is not None and await self._send_bufs_vectored(sock, bufs):
+                return
         try:
-            # Consecutive synchronous writes: frame parts cannot interleave
-            # with other frames (single loop thread, no await in between).
+            # Legacy sequential-write shape (also the fallback when envelope
+            # bytes are still backlogged in the transport — ordering must go
+            # through the same buffer then). Consecutive synchronous writes:
+            # frame parts cannot interleave with other frames (single loop
+            # thread, no await in between).
             self.writer.write(bytes(prefix))
-            self.writer.write(payload)
+            for p in parts:
+                self.writer.write(p)
             if ptag:
                 self.writer.write(ptag)
         except Exception:
@@ -542,6 +737,135 @@ class Connection:
                 self.writer.transport.set_write_buffer_limits(0)
             async with self._send_lock:
                 await self.writer.drain()
+
+    async def _send_bufs_vectored(self, sock, bufs: list) -> bool:
+        """Ship ``bufs`` as one sendmsg syscall directly on the socket. Only
+        valid while the transport buffer is EMPTY (then the transport has no
+        writer registered and kernel-order == our order) — checked under the
+        raw-send lock; returns False (caller takes the sequential path) when
+        envelope bytes are backlogged there. The common case — 4 MiB frame
+        into a 4 MiB SO_SNDBUF — completes in that single syscall with ZERO
+        userspace copies (the sequential-write path pays a transport-buffer
+        memcpy for every byte the first write couldn't flush). A partial
+        send finishes via sock_sendall on a dup'd fd under _tx_hold so
+        envelope flushes can't splice into the frame.
+        """
+        if len(bufs) > 64:  # stay far under IOV_MAX; absurd part counts take the sequential path
+            return False
+        async with self._raw_send_lock:
+            if self._closed or self.writer.transport.get_write_buffer_size() > 0:
+                return False
+            if self._raw_send_sock is None:
+                try:
+                    # The transport's extra-info socket is a TransportSocket
+                    # facade without send methods; sendmsg needs a real
+                    # socket on a dup'd fd (same trick as _read_raw_into).
+                    self._raw_send_sock = socket.socket(fileno=os.dup(sock.fileno()))
+                    self._raw_send_sock.setblocking(False)
+                except OSError:
+                    return False
+            try:
+                sent = self._raw_send_sock.sendmsg(bufs)  # graftlint: disable=counted-transfers  send_raw counts the whole frame before dispatching to this path helper
+            except (BlockingIOError, InterruptedError):
+                sent = 0
+            except OSError:
+                return True  # transport gone: the read loop tears the connection down
+            total = sum(len(b) for b in bufs)
+            if sent == total:
+                return True
+            self._tx_hold = True
+            try:
+                for b in bufs:
+                    if sent >= len(b):
+                        sent -= len(b)
+                        continue
+                    mv = b if isinstance(b, memoryview) else memoryview(b)
+                    try:
+                        await self._loop.sock_sendall(self._raw_send_sock, mv[sent:] if sent else mv)  # graftlint: disable=counted-transfers  remainder of a frame send_raw already counted
+                    except OSError:
+                        return True  # peer gone mid-frame; read loop tears down
+                    sent = 0
+            finally:
+                self._release_tx_hold()
+            return True
+
+    def _release_tx_hold(self):
+        self._tx_hold = False
+        if self._out and not self._flush_scheduled and not self._closed:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush_out)
+
+    async def send_raw_file(self, key: bytes, fd: int, offset: int, length: int) -> None:
+        """Send one raw-lane frame whose payload is ``length`` bytes at
+        ``offset`` of file descriptor ``fd``, fd->socket via os.sendfile —
+        the payload never enters userspace (kills the pread->bytes->write
+        double copy on the spilled-chunk serve path). ONLY callable with
+        auth disabled: a MAC needs the bytes in userspace, so authenticated
+        links serve spilled chunks via pread + send_raw instead (callers
+        gate on get_auth_token())."""
+        global _SEND_BYTES, _RAW_SEND_BYTES
+        if _frame_key:
+            raise RpcError("send_raw_file requires auth off (MAC needs userspace bytes)")
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.peer_name} closed")
+        if await self._raw_send_fault():
+            return  # chunk never lands; the puller's deadline fails it over
+        hdr = pickle.dumps((key, length), protocol=5)
+        ln = 1 + 4 + len(hdr) + length
+        prefix = bytearray(ln.to_bytes(_HDR, "little"))
+        prefix += _RAW
+        prefix += len(hdr).to_bytes(4, "little")
+        prefix += hdr
+        _SEND_BYTES += ln + _HDR
+        _RAW_SEND_BYTES += ln + _HDR
+        await _net_pace(ln + _HDR)
+        sock = self.writer.get_extra_info("socket")
+        if sock is None or not hasattr(os, "sendfile"):
+            raise RpcError("transport does not support sendfile")
+        async with self._raw_send_lock:
+            # Flush any transport-buffered envelope bytes first so the frame
+            # lands after them, then own the socket for the whole frame.
+            if self.writer.transport.get_write_buffer_size() > 0:
+                if not self._raw_zero_limits:
+                    self._raw_zero_limits = True
+                    self.writer.transport.set_write_buffer_limits(0)
+                async with self._send_lock:
+                    await self.writer.drain()
+            self._tx_hold = True
+            try:
+                if self._raw_send_sock is None:
+                    self._raw_send_sock = socket.socket(fileno=os.dup(sock.fileno()))
+                    self._raw_send_sock.setblocking(False)  # dup'd fd: same trick as _read_raw_into
+                await self._loop.sock_sendall(self._raw_send_sock, prefix)
+                pos, left = offset, length
+                while left > 0:
+                    try:
+                        k = os.sendfile(self._raw_send_sock.fileno(), fd, pos, left)
+                    except (BlockingIOError, InterruptedError):
+                        k = 0
+                    if k == 0:
+                        await self._sock_writable(self._raw_send_sock)
+                        continue
+                    pos += k
+                    left -= k
+            except OSError:
+                return  # peer gone mid-frame; read loop tears down
+            finally:
+                self._release_tx_hold()
+
+    def _sock_writable(self, sock) -> "asyncio.Future":
+        """Await socket writability (sendfile has no asyncio wrapper that
+        takes a raw fd + explicit offset, so the wait is hand-rolled)."""
+        fut = self._loop.create_future()
+        fd = sock.fileno()
+
+        def _ready():
+            self._loop.remove_writer(fd)
+            if not fut.done():
+                fut.set_result(None)
+
+        self._loop.add_writer(fd, _ready)
+        return fut
 
     async def _read_raw_frame(self, ln: int) -> bool:
         """Decode one raw frame (marker byte already consumed). Returns False
@@ -571,37 +895,49 @@ class Connection:
                 logger.warning("rejecting unauthenticated raw frame from %s", self.peer_name)
                 return False
         try:
-            key, plen = pickle.loads(hdr)
+            tup = pickle.loads(hdr)
+            key, plen = tup[0], tup[1]
+            flags = tup[2] if len(tup) > 2 else 0  # 2-tuple = v3 per-chunk frame
         except Exception:
             logger.warning("dropping peer %s: garbled raw header", self.peer_name)
             return False
-        if pos + plen + (_TAG_LEN if _frame_key else 0) != ln:
+        noptag = bool(flags & _RAW_F_NOPTAG)
+        if pos + plen + (_TAG_LEN if (_frame_key and not noptag) else 0) != ln:
             logger.warning("dropping peer %s: raw frame length mismatch", self.peer_name)
             return False
-        hasher = None
-        if _frame_key:
-            hasher = _raw_payload_hasher()
-            hasher.update(hdr)
         entry = self._raw_expect.pop(key, None)
         if entry is not None and len(entry[0]) == plen:
-            dest, fut = entry
+            dest, fut, whasher = entry
             claimed = True
         else:
             # Unclaimed or mis-sized chunk: stay framed by consuming the
-            # payload into a throwaway buffer.
+            # payload into a throwaway buffer. (Window mode: the skipped
+            # bytes never reach the shared window hasher, so the window tag
+            # comparison fails and the whole window refetches per-chunk —
+            # a mis-sized frame can't silently poison its windowmates.)
             if entry is not None:
                 logger.warning(
                     "raw chunk %s from %s: size mismatch (got %d, expected %d)",
                     key.hex()[:8], self.peer_name, plen, len(entry[0]),
                 )
             dest, fut, claimed = memoryview(bytearray(plen)), entry[1] if entry else None, False
+            whasher = None
+        hasher = None
+        if _frame_key:
+            if noptag:
+                # Window mode: payload bytes stream into the window's shared
+                # MAC (verified out of band over the whole window).
+                hasher = whasher
+            else:
+                hasher = _raw_payload_hasher()
+                hasher.update(hdr)
         try:
             await self._read_raw_into(dest, plen, hasher)
         except BaseException:
             if fut is not None and not fut.done():
                 fut.set_result(False)
             raise
-        if _frame_key:
+        if _frame_key and not noptag:
             ptag = await reader.readexactly(_TAG_LEN)
             if not hmac.compare_digest(ptag, hasher.digest()[:_TAG_LEN]):
                 logger.warning("rejecting tampered raw payload from %s", self.peer_name)
@@ -809,16 +1145,18 @@ class Connection:
                 fut.set_exception(ConnectionLost(f"connection to {self.peer_name} lost"))
                 fut.add_done_callback(lambda f: f.exception())
         self._pending.clear()
-        for _dest, fut in self._raw_expect.values():
-            if not fut.done():
-                fut.set_result(False)  # chunk never landed; puller retries elsewhere
+        for entry in self._raw_expect.values():
+            if not entry[1].done():
+                entry[1].set_result(False)  # chunk never landed; puller retries elsewhere
         self._raw_expect.clear()
-        if self._raw_sock is not None:
-            try:
-                self._raw_sock.close()
-            except Exception:
-                pass
-            self._raw_sock = None
+        for attr in ("_raw_sock", "_raw_send_sock"):
+            s = getattr(self, attr)
+            if s is not None:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+                setattr(self, attr, None)
         try:
             self.writer.close()
         except Exception:
@@ -860,6 +1198,9 @@ class RpcServer:
         return f"{self.host}:{self.port}"
 
     async def _on_client(self, reader, writer):
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            _tune_peer_socket(sock)
         conn = Connection(reader, writer, self.handler, peer_name="client")
         self.connections.add(conn)
         conn.on_close = self.connections.discard
@@ -967,8 +1308,10 @@ async def connect(addr: str, handler: Any = None, timeout: float = 10.0, retry: 
             else:
                 reader, writer = await asyncio.open_connection(kind_parts[1], kind_parts[2])
             sock = writer.get_extra_info("socket")
-            if sock is not None and sock.family in (socket.AF_INET, socket.AF_INET6):
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if sock is not None:
+                _tune_peer_socket(sock)
+                if sock.family in (socket.AF_INET, socket.AF_INET6):
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             return Connection(reader, writer, handler, peer_name=addr)
         except (ConnectionRefusedError, FileNotFoundError, OSError) as e:
             last_err = e

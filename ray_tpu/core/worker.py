@@ -687,6 +687,7 @@ class CoreWorker:
             reply = await self.daemon.call("register_worker", {"worker_id": self.worker_id, "address": self.address})
             self.node_id = reply["node_id"]
             self.config = self.config.adopt_cluster(reply["config"])
+            rpc.apply_transport_config(self.config)
             if self.config.chaos_spec:
                 _chaos.install_from_json(self.config.chaos_spec)
             if self.store is not None:

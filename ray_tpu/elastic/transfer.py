@@ -67,7 +67,7 @@ class _Export:
                  meta: dict):
         self.rank = rank
         self.seq = seq
-        self.arrays = arrays          # path -> np.ndarray (contiguous copy)
+        self.arrays = arrays          # path -> contiguous np.ndarray (copy or parked ref)
         self.views = {p: memoryview(a).cast("B") for p, a in arrays.items()}
         self.paths = paths            # path -> {shape,dtype,kind,n,rect}
         self.meta = meta
@@ -87,27 +87,37 @@ def _frame_key(tid: str, dst_rank: int, path: str, dst_off: int,
 
 def export_state(tid: str, rank: int, replicated: dict,
                  sharded: Optional[dict] = None, *, seq: int = 0,
-                 meta: Optional[dict] = None) -> dict:
+                 meta: Optional[dict] = None, copy: bool = True) -> dict:
     """Park a snapshot for transfer ``tid`` and return its wire metadata.
 
     ``replicated``: {path: array} — every rank holds the full array (rect =
     whole shape). ``sharded``: {path: (flat_1d_array, lo, n_total)} — this
     rank holds [lo, lo+len) of a logical length-``n_total`` flat array (the
-    grad_sync optimizer windows). Arrays are COPIED: the train thread may
-    keep mutating its originals after the snapshot point."""
+    grad_sync optimizer windows).
+
+    ``copy=True`` (default): arrays are copied — the train thread may keep
+    mutating its originals after the snapshot point. ``copy=False`` parks
+    REFERENCES (the ckpt plane's snapshot_tree idiom: for an immutable jax
+    leaf, grabbing the reference IS the snapshot): valid when the caller
+    guarantees the leaves won't be mutated while parked — either jax arrays
+    (np.asarray then does the device->host transfer HERE, off the train
+    step, and yields a fresh host buffer anyway) or numpy arrays that are
+    themselves private copies (a keep_live(copy=True) registration). The
+    reshard_export path passes False: its leaves are exactly those two
+    kinds, so the per-leaf memcpy of every export was pure overhead."""
     arrays: dict = {}
     paths: dict = {}
     for path, a in (replicated or {}).items():
         src = np.asarray(a)
         shape = src.shape  # BEFORE ascontiguousarray: it ravels 0-d to (1,)
         arr = np.ascontiguousarray(src)
-        arrays[path] = arr.copy()
+        arrays[path] = arr.copy() if copy else arr
         paths[path] = {"kind": "replicated", "shape": list(shape),
                        "dtype": str(arr.dtype),
                        "rect": [[0, int(d)] for d in shape]}
     for path, (a, lo, n) in (sharded or {}).items():
         arr = np.ascontiguousarray(np.asarray(a)).reshape(-1)
-        arrays[path] = arr.copy()
+        arrays[path] = arr.copy() if copy else arr
         paths[path] = {"kind": "window", "shape": [int(n)],
                        "dtype": str(arr.dtype), "n": int(n),
                        "rect": [[int(lo), int(lo) + arr.size]]}

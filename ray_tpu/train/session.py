@@ -155,8 +155,16 @@ class TrainSession:
         ``meta``: small picklable dict returned verbatim on resume (step
         counter, optimizer t, rng state...). ``copy=True`` snapshots leaves
         with np.copy so in-place mutation by the NEXT step (adam slots)
-        cannot tear the parked bytes; pass False only for immutable (jax)
-        arrays."""
+        cannot tear the parked bytes — required for numpy buffers mutated in
+        place (ShardedOptimizerStep's m/v windows). ``copy=False`` registers
+        REFERENCES and is the right call for jax leaves: jax arrays are
+        immutable, so grabbing the reference IS the snapshot (the ckpt
+        plane's snapshot_tree idiom), this step pays ZERO per-leaf memcpys
+        AND zero device->host transfers — the export/writer side does the
+        device->host materialization (np.asarray) only when a reshard or
+        save actually consumes the snapshot, off the step path. A
+        copy=False registration also lets the elastic export park its
+        arrays by reference end to end (export_state(copy=False))."""
         import numpy as _np
 
         if self.stop_event.is_set():

@@ -130,9 +130,14 @@ class TrainWorker:
         snap = self.session.live_snapshot() if self.session else None
         if snap is None:
             return None
+        # copy=False: the snapshot's leaves are either the session's private
+        # keep_live(copy=True) copies (never mutated once parked) or
+        # immutable jax arrays from keep_live(copy=False) — export_state
+        # parks references and the old per-leaf memcpy disappears from the
+        # preemption-to-export critical path.
         meta = _transfer.export_state(
             tid, self.world_rank, snap["state"], snap["sharded"],
-            seq=snap["seq"], meta=snap["meta"])
+            seq=snap["seq"], meta=snap["meta"], copy=False)
         meta["addr"] = _api._require_worker().address
         return meta
 

@@ -906,6 +906,69 @@ def test_counted_sheds_suppressed_without_reason_still_fires():
 
 
 # ---------------------------------------------------------------------------
+# counted-transfers
+# ---------------------------------------------------------------------------
+
+def test_counted_transfers_fires_on_uncounted_sendfile():
+    r = _lint("""
+        import os
+
+        def serve(self, fd, pos, left):
+            os.sendfile(self.sock.fileno(), fd, pos, left)
+    """)
+    hits = [f for f in r.findings if f.rule == "counted-transfers"]
+    assert len(hits) == 1 and hits[0].line == 5
+
+
+def test_counted_transfers_fires_on_uncounted_sendmsg():
+    r = _lint("""
+        def ship(self, bufs):
+            sent = self.sock.sendmsg(bufs)
+            return sent
+    """)
+    hits = [f for f in r.findings if f.rule == "counted-transfers"]
+    assert len(hits) == 1 and "sendmsg" in hits[0].message
+
+
+def test_counted_transfers_quiet_when_counted():
+    r = _lint("""
+        import os
+
+        def serve(self, fd, pos, left):
+            n = os.sendfile(self.sock.fileno(), fd, pos, left)
+            self.bytes_out += n
+
+        def ship(self, bufs, metrics):
+            sent = self.sock.sendmsg(bufs)
+            metrics.transfer_bytes.inc(sent)
+    """)
+    assert "counted-transfers" not in _rules_hit(r)
+
+
+def test_counted_transfers_ignores_plain_send_and_names():
+    """Bare socket.send/sendall and functions merely named sendfile are not
+    kernel-assisted transfer syscalls tracked by this rule."""
+    r = _lint("""
+        def relay(self, data):
+            self.sock.sendall(data)
+
+        def sendfile(path):
+            return path
+    """)
+    assert "counted-transfers" not in _rules_hit(r)
+
+
+def test_counted_transfers_suppressed_with_reason():
+    r = _lint("""
+        def finish(self, mv):
+            await_result = self.loop.sock_sendall(self.sock, mv)  # graftlint: disable=counted-transfers  caller counted the whole frame
+            return await_result
+    """)
+    assert "counted-transfers" not in _rules_hit(r)
+    assert len(r.suppressions) == 1
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: whole tree at zero, report written, CLI contract
 # ---------------------------------------------------------------------------
 

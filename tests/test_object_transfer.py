@@ -246,6 +246,7 @@ def test_multi_source_failover_mid_object(fresh_cluster):
     b = cluster.add_node(num_cpus=1)
     c = cluster.add_node(num_cpus=1)
     c.config.pull_chunk_size = 1024 * 1024  # 13 chunks: failure lands mid-object
+    c.config.raw_mac_granularity = "chunk"  # per-chunk striping/failover is what's under test
     payload = os.urandom(12 * 1024 * 1024 + 7)
     oid = _seed_object(a, payload)
     # replicate A -> B so C has two sources
@@ -274,6 +275,7 @@ def test_concurrent_pulls_coalesce(fresh_cluster):
     a = cluster.add_node(num_cpus=1)
     b = cluster.add_node(num_cpus=1)
     b.config.pull_chunk_size = 1024 * 1024
+    b.config.raw_mac_granularity = "chunk"  # count per-chunk serves
     payload = os.urandom(6 * 1024 * 1024)
     oid = _seed_object(a, payload)
 
@@ -392,6 +394,7 @@ def test_failed_pull_aborts_cleanly_and_oid_stays_pullable(fresh_cluster):
     b = cluster.add_node(num_cpus=1)
     payload = os.urandom(9 * 1024 * 1024 + 7)
     oid = _seed_object(a, payload)
+    b.config.raw_mac_granularity = "chunk"  # the sabotaged handler is the per-chunk one
 
     async def fail_then_recover():
         # Sabotage: every chunk read on A explodes after the probe, so the
