@@ -199,6 +199,9 @@ def main(argv=None):
     from ray_tpu.chaos import add_chaos_parser, cmd_chaos
 
     add_chaos_parser(sub)  # seeded fault-injection scenario runner
+    from ray_tpu.obs.ledger import add_report_parser, cmd_report
+
+    add_report_parser(sub)  # offline run-ledger render/diff/gate; never connects
     ep = sub.add_parser("events")
     ep.add_argument("--limit", type=int, default=100)
     sub.add_parser("metrics")
@@ -239,6 +242,8 @@ def main(argv=None):
         sys.exit(cmd_lint(args))
     if args.cmd == "chaos":
         sys.exit(cmd_chaos(args))
+    if args.cmd == "report":
+        sys.exit(cmd_report(args))
     if args.cmd == "start":
         sys.exit(scripts.cmd_start(args))
     if args.cmd == "stop":

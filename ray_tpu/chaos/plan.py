@@ -75,6 +75,10 @@ class FaultRule:
           the pattern names a concrete site.
     nth / every / p: fire on exactly the nth matching hit (1-based), on every
           Nth hit, or with probability p per hit (seed-hashed, deterministic).
+    skip: ignore the first N matching hits before nth/every/p apply — with
+          max_faults this projects a *window* in hit space, which is how the
+          replay timeline anchors "slow replicas during the storm phase" onto
+          a deterministic counter instead of a wall clock.
     max_faults: stop firing after this many injections (0 = unlimited).
     delay_s: parameter for delay/stall/kill-after kinds.
     """
@@ -84,6 +88,7 @@ class FaultRule:
     nth: int = 0
     every: int = 0
     p: float = 1.0
+    skip: int = 0
     max_faults: int = 0
     delay_s: float = 0.05
     ctx: dict = field(default_factory=dict)
@@ -94,7 +99,7 @@ class FaultRule:
 
     @classmethod
     def from_spec(cls, spec: dict) -> "FaultRule":
-        known = {"site", "kind", "nth", "every", "p", "max_faults", "delay_s", "ctx", "args"}
+        known = {"site", "kind", "nth", "every", "p", "skip", "max_faults", "delay_s", "ctx", "args"}
         unknown = set(spec) - known
         if unknown:
             raise ValueError(f"unknown fault-rule keys {sorted(unknown)} (known: {sorted(known)})")
@@ -106,6 +111,7 @@ class FaultRule:
             nth=int(spec.get("nth", 0)),
             every=int(spec.get("every", 0)),
             p=float(spec.get("p", 1.0)),
+            skip=int(spec.get("skip", 0)),
             max_faults=int(spec.get("max_faults", 0)),
             delay_s=float(spec.get("delay_s", 0.05)),
             ctx=dict(spec.get("ctx", {})),
@@ -120,6 +126,8 @@ class FaultRule:
             out["every"] = self.every
         if self.p != 1.0:
             out["p"] = self.p
+        if self.skip:
+            out["skip"] = self.skip
         if self.max_faults:
             out["max_faults"] = self.max_faults
         if self.delay_s != 0.05:
@@ -199,12 +207,15 @@ class FaultSchedule:
             if r.ctx and any(str(ctx.get(k)) != str(v) for k, v in r.ctx.items()):
                 continue
             r.hits += 1
+            if r.hits <= r.skip:
+                continue  # still inside the skipped prefix of the window
             if r.max_faults and r.faults >= r.max_faults:
                 continue
+            eligible = r.hits - r.skip  # 1-based position past the skip
             if r.nth:
-                fire = r.hits == r.nth
+                fire = eligible == r.nth
             elif r.every:
-                fire = r.hits % r.every == 0
+                fire = eligible % r.every == 0
             else:
                 fire = True
             if fire and self._chance(i, r.hits, r.p):

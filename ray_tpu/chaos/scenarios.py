@@ -76,6 +76,45 @@ def _drain_retries(refs, timeout: float):
     return [rt.get(r, timeout=timeout) for r in refs]
 
 
+def _metric_sum(series, name, tag=None):
+    """Sum one counter across a merged /metrics snapshot (optionally
+    filtered to a tag subset)."""
+    return sum(
+        rec.get("value", 0.0) for rec in series
+        if rec.get("name") == name
+        and (tag is None or all(rec.get("tags", {}).get(k) == v for k, v in tag.items()))
+    )
+
+# The process-global counters scenario accounting reads. Chaos counters are
+# reset by plan.install(), but these live in serve/qos/ckpt Counter objects
+# that survive across sessions in one process — a replay in a long-lived
+# process (test suite, repeated CLI runs) inherits their counts.
+_BASELINE_NAMES = (
+    "serve.request.shed_total",
+    "serve.request.expired_total",
+    "qos.exec.expired_total",
+    "ckpt.publish.swaps_total",
+    "ckpt.publish.failures_total",
+    "chaos.injected_total",
+)
+
+
+def _baseline_counters(core, names=_BASELINE_NAMES) -> dict:
+    """Snapshot the counters BEFORE driving load so every scenario asserts
+    on DELTAS (PR 8 lesson: exact-accounting checks against absolute values
+    pass alone and fail under `pytest tests/`)."""
+    core._run(core._report_metrics())
+    series = core._run(core.controller.call("get_metrics", {}))
+    return {n: _metric_sum(series, n) for n in names}
+
+
+def _counter_deltas(core, baseline: dict) -> dict:
+    """Current merged-view value minus the baseline, per counter."""
+    core._run(core._report_metrics())
+    series = core._run(core.controller.call("get_metrics", {}))
+    return {n: _metric_sum(series, n) - v for n, v in baseline.items()}
+
+
 # ---------------------------------------------------------------------------
 # Scenarios. Each returns {"details": ..., "min_injections": int,
 # "min_metric_injections": int | None} and leaves the driver connected for
@@ -462,26 +501,15 @@ def _scn_overload_storm(seed: int, quick: bool) -> dict:
     _require(row["state"] == "ok" and row["alerts_fired"] == 0,
              f"SLO alerted on an idle deployment (quiet path not alert-free): {row}")
 
-    # Baseline the QoS counters BEFORE the load: the driver's metric
-    # registry is process-global and may carry counts from earlier sessions
-    # in the same process (e.g. a test suite) — the exact-accounting
-    # assertions below are on DELTAS.
+    # Baseline the QoS counters BEFORE the load (shared helper — see
+    # _baseline_counters): the exact-accounting assertions below are DELTAS.
     from ray_tpu.core import api
 
     core = api._require_worker()
-
-    def _metric_sum(series, name, tag=None):
-        return sum(
-            rec.get("value", 0.0) for rec in series
-            if rec.get("name") == name
-            and (tag is None or all(rec.get("tags", {}).get(k) == v for k, v in tag.items()))
-        )
-
-    core._run(core._report_metrics())
-    series0 = core._run(core.controller.call("get_metrics", {}))
-    shed0 = _metric_sum(series0, "serve.request.shed_total")
-    expired0 = _metric_sum(series0, "serve.request.expired_total")
-    tripwire0 = _metric_sum(series0, "qos.exec.expired_total")
+    base = _baseline_counters(core)
+    shed0 = base["serve.request.shed_total"]
+    expired0 = base["serve.request.expired_total"]
+    tripwire0 = base["qos.exec.expired_total"]
 
     duration = 4.0 if quick else 7.0
     stop_at = time.monotonic() + duration
@@ -1272,8 +1300,245 @@ def _scn_elastic_preempt(seed: int, quick: bool) -> dict:
     }
 
 
+def _scn_day_in_the_life(seed: int, quick: bool) -> dict:
+    """Trace-driven day-in-the-life replay (ROADMAP item 2): a seeded
+    multi-tenant workload trace (diurnal calm->storm->recovery envelope,
+    Zipf tenant skew, streaming/batch blend) replayed open-loop against a
+    live autoscaled serve app, under a declarative chaos timeline — slow
+    replicas through the storm, a client-network flap in the calm phase, a
+    TPU-preemption notice and a live weight publication in recovery — and
+    every observability surface folded into ONE run ledger that must pass
+    its own gates. Everything replays from the seed: the trace bytes, the
+    fault rules (hit-space projection), and the timeline's action order.
+
+    Pinned here, beyond the standard battery:
+
+    * the preempted slice host drains and dies (the timeline's
+      control-free preemption notice really landed);
+    * the mid-run weight publication hot-swaps into serving replicas
+      (version visible through the handle) without an error blip;
+    * the ledger's own gates hold: interactive storm-phase p99/goodput,
+      bounded swap blip, and a burn-rate trajectory for every objective.
+    """
+    import hashlib
+    import threading
+
+    import numpy as np
+    import ray_tpu as rt
+    from ray_tpu import replay as _replay
+    from ray_tpu.accel.tpu import TPU_SLICE_NAME_LABEL, TPU_WORKER_ID_LABEL
+    from ray_tpu.core.api import Cluster, init
+    from ray_tpu.obs import ledger as _ledger
+
+    params = _replay.default_params(quick=quick)
+    time_warp = 2.0 if quick else 1.5
+    header, records = _replay.synthesize(seed, **params)
+    trace_sha = hashlib.sha256(_replay.dumps_trace(header, records)).hexdigest()
+    spans = _replay.phase_spans(params)
+    heartbeat_s = 0.25
+    timeline = _replay.Timeline(spans, [
+        # Storm phase: every replica request drags an injected exec delay.
+        {"action": "slow_replica_window", "phase": "storm", "delay_s": 0.04,
+         "deployment": "DayApp"},
+        # Calm phase: client-side network flap (replayer-side delays).
+        {"action": "client_flap", "phase": "calm", "offset_s": 1.0,
+         "kind": "delay", "delay_s": 0.03, "every": 9},
+        # Recovery: the slice host gets its preemption notice...
+        {"action": "tpu_preempt", "phase": "recovery", "offset_s": 0.6,
+         "worker_id": "1", "slice": "slice-a", "grace_s": 0.3},
+        # ...and new weights go live mid-traffic, with the swap chaos-delayed.
+        {"action": "chaos_rule", "rule": {"site": "ckpt.publish.swap",
+                                          "kind": "delay", "nth": 1,
+                                          "delay_s": 0.05}},
+        {"action": "publish_weights", "phase": "recovery", "offset_s": 0.3,
+         "channel": "day-weights", "step": 1},
+    ])
+    # lead_s is a CONSTANT estimate (victim-host add -> replay start): the
+    # compiled nth must not depend on measured wall time or two same-seed
+    # runs would emit different injection logs.
+    compiled = timeline.compile(seed, records, time_warp=time_warp,
+                                heartbeat_s=heartbeat_s, lead_s=1.0)
+
+    cfg = _fresh_config()
+    cfg.heartbeat_interval_s = heartbeat_s
+    # overload_storm's AIMD/SLO knobs: converge inside the storm window.
+    cfg.qos_target_delay_s = 0.08
+    cfg.qos_min_concurrency = 2
+    cfg.qos_initial_concurrency = 8
+    cfg.qos_adapt_interval_s = 0.25
+    cfg.slo_eval_interval_s = 0.25
+    cfg.chaos_spec = json.dumps(compiled.spec)
+    _plan.install_from_json(cfg.chaos_spec)
+    cluster = _register_cluster(Cluster(initialize_head=False, config=cfg))
+    cluster.add_node(num_cpus=8)
+    init(address=cluster.address, config=cfg)
+    from ray_tpu import ckpt as _ckpt
+    from ray_tpu import obs as _obs
+    from ray_tpu import serve
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    @serve.deployment(name="DayApp", max_ongoing_requests=2,
+                      # 2 CPUs/replica: replicas can never land on the
+                      # 1-CPU slice host the timeline preempts.
+                      ray_actor_options={"num_cpus": 2.0},
+                      autoscaling_config=AutoscalingConfig(
+                          min_replicas=1, max_replicas=2,
+                          target_ongoing_requests=1.0,
+                          upscale_delay_s=0.3, downscale_delay_s=0.6,
+                          cooldown_s=2.0))
+    class DayApp:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._version = "v0"
+            self._sub = _ckpt.WeightSubscriber("day-weights", self._swap,
+                                               poll_interval_s=0.25)
+
+        def _swap(self, tree, summary):
+            with self._lock:
+                self._version = summary["ckpt_id"]
+
+        def __call__(self, request):
+            if request.headers.get("x-stream") == "1":
+                def tokens():
+                    yield "data: tok0\n\n"
+                    time.sleep(0.004)
+                    yield "data: tok1\n\n"
+                return tokens()
+            time.sleep(0.004)
+            with self._lock:
+                return self._version
+
+        def version(self):
+            with self._lock:
+                return self._version
+
+    serve.run(DayApp.bind(), name="day", route_prefix="/day")
+    port = serve.http_port()
+    for spec in (
+        {"name": "day-availability", "metric": "availability",
+         "app": "day", "deployment": "DayApp",
+         "fast_window_s": 1.0, "slow_window_s": 3.0, "burn_threshold": 2.0},
+        {"name": "day-latency", "metric": "latency", "target": 0.5,
+         "quantile": 0.95, "app": "day", "deployment": "DayApp",
+         "fast_window_s": 1.0, "slow_window_s": 3.0, "burn_threshold": 2.0},
+    ):
+        serve.register_slo(spec)
+
+    # Checkpoint plumbing for the timeline's publish_weights action.
+    storage = tempfile.mkdtemp(prefix="raytpu_day_ckpt_")
+    store = _ckpt.ChunkStore(storage, chunk_size=8192)
+    manifests = _ckpt.ManifestStore(storage, num_to_keep=2, chunk_store=store)
+
+    def _publish(action):
+        step = int(action.get("step", 1))
+        w = np.full((8, 8), float(step), np.float32)
+        snap = {"model/w": {"dtype": "float32", "shape": [8, 8],
+                            "shards": [([[0, 8], [0, 8]], w)]}}
+        part = _ckpt.write_part(store, snap, rank=0, step=step)
+        m = _ckpt.commit_parts(manifests, _ckpt.new_ckpt_id(step), step,
+                               [part], 1, channel=action["channel"],
+                               meta={"step": step})
+        _ckpt.publish_checkpoint(m, action["channel"])
+        return {"ckpt_id": m["ckpt_id"]}
+
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    base = _baseline_counters(core)
+    # The bystander slice host joins only after the serve control plane is
+    # placed (its actors must not land on the node the timeline preempts).
+    victim = cluster.add_node(num_cpus=1, resources={"TPU": 4.0},
+                              labels={TPU_SLICE_NAME_LABEL: "slice-a",
+                                      TPU_WORKER_ID_LABEL: "1"})
+
+    driver = _replay.TimelineDriver(
+        compiled.control, {"publish_weights": _publish},
+        time_warp=time_warp).start()
+    outcomes = _replay.Replayer(port, time_warp=time_warp,
+                                max_workers=32).run(header, records)
+    tl_log = driver.join(timeout=120)
+
+    # -- the preemption notice really took the slice host down -------------
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        nodes = core._run(core.controller.call("get_cluster_state", {}))["nodes"]
+        if nodes.get(victim.node_id, {}).get("state") == "DEAD":
+            break
+        time.sleep(0.2)
+    else:
+        raise ScenarioFailure("timeline preemption never took the slice host down")
+
+    # -- the published weights went live in serving replicas ---------------
+    published = next((e["detail"]["ckpt_id"] for e in tl_log
+                      if e["action"] == "publish_weights" and e.get("ok")), None)
+    _require(published is not None,
+             f"timeline weight publication failed: {tl_log}")
+    h = serve.get_deployment_handle("DayApp", "day")
+    ver = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        ver = h.options(method_name="version").remote().result(timeout=30)
+        if ver == published:
+            break
+        time.sleep(0.25)
+    _require(ver == published,
+             f"replica never hot-swapped to {published} (still at {ver})")
+
+    # -- fold everything into the run ledger and judge it ------------------
+    deltas = {}
+    deadline = time.monotonic() + 10  # replica reporters tick at 0.5s
+    while time.monotonic() < deadline:
+        deltas = _counter_deltas(core, base)
+        if deltas.get("ckpt.publish.swaps_total", 0) >= 1:
+            break
+        time.sleep(0.4)
+    ctl = rt.get_actor("__serve_controller__", namespace="serve")
+    dep = rt.get(ctl.get_serve_state.remote(), timeout=30)["apps"]["day"]["DayApp"]
+    ledger = _ledger.build(
+        meta={"scenario": "day_in_the_life", "seed": seed,
+              "quick": bool(quick), "time_warp": time_warp,
+              "requests": header["requests"], "trace_sha256": trace_sha},
+        spans=spans,
+        load=_replay.summarize(outcomes, phases=spans),
+        slo={"status": serve.slo_status(), "history": _obs.slo_history()},
+        counters=deltas,
+        autoscaler={"decisions": dep["decisions"],
+                    "dropped": dep["decisions_dropped"]},
+        autopsy=_obs.autopsy_summary(),
+        chaos={"injections": _plan.injection_log(normalize=True),
+               "count": int(deltas.get("chaos.injected_total", 0))},
+        timeline=tl_log,
+    )
+    rundir = tempfile.mkdtemp(prefix="raytpu_day_run_")
+    trace_path = os.path.join(rundir, "trace.jsonl")
+    _replay.write_trace(trace_path, header, records)
+    ledger_path = os.path.join(rundir, "ledger.json")
+    _ledger.save(ledger_path, ledger)
+    gate_res = _ledger.gate(ledger)
+    _require(gate_res["ok"], f"run ledger failed its gates: {gate_res['checks']}")
+    from ray_tpu.serve.handle import _reset_registry
+
+    _reset_registry()  # park router threads before the invariant battery
+    return {
+        "cluster": cluster,
+        "details": {
+            "trace_sha256": trace_sha, "trace_path": trace_path,
+            "ledger_path": ledger_path, "gate": gate_res,
+            "total": ledger["load"]["total"], "swap_version": published,
+            "timeline": tl_log,
+        },
+        # Driver-side deterministic fires: the calm-phase client flap
+        # (fixed hit window over a fixed record count) + the preemption
+        # notice (fixed nth). Replica-side fires (slow window, swap delay)
+        # reach /metrics via the reporters.
+        "min_injections": 2,
+        "min_metric_injections": 3,
+    }
+
+
 SCENARIOS: dict = {
     "worker_kill": _scn_worker_kill,
+    "day_in_the_life": _scn_day_in_the_life,
     "elastic_preempt": _scn_elastic_preempt,
     "pull_source_death": _scn_pull_source_death,
     "controller_restart": _scn_controller_restart,

@@ -134,6 +134,18 @@ SITES: dict = {
                      "a replica is slow to arrive — scenario autoscale_flap), "
                      "reconcile retry of failed starts",
     },
+    # -- L4.5: replay plane (the load generator is part of the system) ----
+    "replay.request.send": {
+        "layer": "replay",
+        "kinds": {"drop", "delay"},
+        "desc": "one trace record about to be fired by the open-loop "
+                "replayer (drop: client-side loss, the request never "
+                "reaches the wire; delay: client network flap before send)",
+        "exercises": "ingress under lossy/laggy clients: goodput accounting "
+                     "distinguishes client loss from server shed, late "
+                     "arrivals ride the same deadline machinery (scenario "
+                     "day_in_the_life)",
+    },
     # -- L5.5: elastic train plane ----------------------------------------
     "elastic.reshard.transfer": {
         "layer": "elastic",

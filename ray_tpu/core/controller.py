@@ -1123,6 +1123,9 @@ class Controller:
     def handle_slo_summary(self, conn, p):
         return self.slo_engine.summary()
 
+    def handle_slo_history(self, conn, p):
+        return self.slo_engine.history()
+
     def handle_report_flight_dump(self, conn, p):
         """A worker/daemon just wrote (or harvested) a black-box flight dump;
         index the path so `raytpu debug` and /api/events can point at it."""

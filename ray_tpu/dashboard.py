@@ -145,10 +145,17 @@ def _payload(path: str):
         return core._run(core.controller.call("autopsy_summary", {}))
     if path.startswith("/api/slo"):
         # SLO burn-rate engine: objective status rows + the one-line rollup.
-        return {
+        # ?history=1 adds each objective's bounded burn trajectory ring.
+        from urllib.parse import parse_qs, urlsplit
+
+        q = parse_qs(urlsplit(path).query)
+        out = {
             "summary": core._run(core.controller.call("slo_summary", {})),
             "objectives": core._run(core.controller.call("slo_status", {})),
         }
+        if (q.get("history") or ["0"])[0] not in ("", "0"):
+            out["history"] = core._run(core.controller.call("slo_history", {}))
+        return out
     if path.startswith("/api/flight"):
         # Black-box dump registry: where every post-mortem file landed.
         return core._run(core.controller.call("list_flight_dumps", {"limit": 50}))

@@ -42,6 +42,15 @@ def slo_status() -> list[dict]:
     return core._run(core.controller.call("slo_status", {}))
 
 
+def slo_history() -> dict:
+    """Burn-rate trajectory per objective: {name: {points: [{ts, burn_fast,
+    burn_slow, state}], dropped}} — the arc, not just the final state."""
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    return core._run(core.controller.call("slo_history", {}))
+
+
 def trace_autopsy(trace_id: str) -> dict:
     """Critical-path hop decomposition of one indexed trace."""
     from ray_tpu.core import api

@@ -110,10 +110,16 @@ class SloTracker:
     samples plus the multi-window alert FSM."""
 
     # Sample retention: enough for the slow window at 1 Hz ingest plus slack.
-    def __init__(self, objective: Objective, max_samples: int = 720):
+    def __init__(self, objective: Objective, max_samples: int = 720,
+                 max_history: int = 720):
         self.objective = objective
         self.samples: collections.deque = collections.deque(maxlen=max_samples)
         self.samples_dropped = 0  # counted trim: ring overflow drops oldest
+        # Burn trajectory: one (ts, burn_fast, burn_slow, state) point per
+        # evaluate() tick, so the run ledger plots the whole arc instead of
+        # sampling whatever the final state happens to be.
+        self.history: collections.deque = collections.deque(maxlen=max_history)
+        self.history_dropped = 0  # counted trim, same ethos as samples
         self.state = OK
         self.burn_fast: Optional[float] = None
         self.burn_slow: Optional[float] = None
@@ -143,6 +149,9 @@ class SloTracker:
         if changed and new == ALERT:
             self.alerts_fired += 1
         self.state = new
+        if len(self.history) == self.history.maxlen:
+            self.history_dropped += 1
+        self.history.append((now, self.burn_fast, self.burn_slow, new))
         return self.status(changed=changed)
 
     def status(self, changed: bool = False) -> dict:
@@ -154,6 +163,16 @@ class SloTracker:
             "alerts_fired": self.alerts_fired,
             "samples": len(self.samples),
             "changed": changed,
+        }
+
+    def history_rows(self) -> dict:
+        """The burn trajectory in wire shape: parallel-free row dicts plus
+        the drop counter (so a truncated trajectory is visible as such)."""
+        return {
+            "points": [{"ts": ts, "burn_fast": bf, "burn_slow": bs,
+                        "state": st}
+                       for ts, bf, bs, st in self.history],
+            "dropped": self.history_dropped,
         }
 
 
@@ -246,6 +265,11 @@ class SloEngine:
 
     def status(self) -> list[dict]:
         return [tr.status() for tr in self.trackers.values()]
+
+    def history(self) -> dict:
+        """objective name -> burn-rate trajectory (/api/slo?history=1 and
+        the run ledger's ``slo`` section both read this shape)."""
+        return {name: tr.history_rows() for name, tr in self.trackers.items()}
 
     def summary(self) -> dict:
         """The one-line rollup `raytpu status` prints."""

@@ -173,6 +173,28 @@ def test_worker_kill_scenario_smoke():
     assert fd["in_flight"], "post-mortem failed to attribute the killed task"
 
 
+def test_day_in_the_life_scenario_smoke():
+    """Tier-1 replay smoke: the quick-mode day_in_the_life run — a seeded
+    trace replayed open-loop through a compiled chaos timeline, judged by
+    the run ledger's own gates. The full-length run rides the `-m slow`
+    scenario battery. Seed 0 is the canonical seed: the trace it produces
+    must match the committed tests/data artifact byte for byte."""
+    import hashlib
+    import pathlib
+
+    report = run_scenario("day_in_the_life", seed=0, quick=True)
+    assert report["ok"], report
+    d = report["details"]
+    committed = (pathlib.Path(__file__).parent / "data"
+                 / "day_in_the_life_seed0.trace.jsonl").read_bytes()
+    assert d["trace_sha256"] == hashlib.sha256(committed).hexdigest()
+    assert d["gate"]["ok"], d["gate"]
+    # the mid-run weight publication landed and both replicas swapped to it
+    assert any(e["action"] == "publish_weights" and e["ok"]
+               for e in d["timeline"])
+    assert report["injections"], "timeline compiled no driver-side faults"
+
+
 @pytest.mark.slow  # heavy battery; tier-1 budget (see CHANGES PR-13)
 def test_overload_storm_scenario_smoke():
     """The QoS acceptance scenario: ~3x overload with chaos-injected replica
