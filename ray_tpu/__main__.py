@@ -167,21 +167,88 @@ def cmd_trace(args):
         print(f"  warning: {err}")
 
 
-def cmd_profile(args):
-    """On-demand CPU profile of a running worker (py-spy-equivalent)."""
-    rt = _connect(args.address)
-    from ray_tpu.core.api import profile_worker
+def _print_fold(fold: dict, args):
+    """Human rendering of a (merged) profile fold: header, plane split,
+    leaf self-time table."""
+    from ray_tpu.obs import profiler as _profiler
 
-    prof = profile_worker(args.worker_addr, args.duration)
-    top = sorted(prof["stacks"].items(), key=lambda kv: -kv[1])[: args.top]
-    print(f"{prof['samples']} samples over {prof['duration_s']}s:")
-    depth = max(0, args.depth)
-    for stack, count in top:
-        frames = stack.split(";")
-        print(f"  {count:6d}  {frames[-1]}")
-        context = frames[:-1][-depth:] if depth else []
-        for f in reversed(context):
-            print(f"          ^ {f}")
+    procs = fold.get("procs") or [fold.get("proc", "?")]
+    print(f"{fold.get('samples', 0)} samples from {len(procs)} process(es) "
+          f"({fold.get('stacks_evicted', 0):g} stacks evicted, "
+          f"{fold.get('samples_dropped', 0):g} samples dropped)")
+    planes = _profiler.plane_split(fold)
+    if planes:
+        print("  planes: " + "  ".join(f"{k}={v:.0%}" for k, v in planes))
+    for frame, count in _profiler.top_frames(fold, args.top):
+        print(f"  {count:6d}  {frame}")
+    for err in fold.get("errors") or []:
+        print(f"  warning: {err}")
+
+
+def cmd_profile(args):
+    """Continuous-profiling plane front door.
+
+    - `raytpu profile` — merged cluster flamegraph from the always-on
+      sampler rings (last --window seconds).
+    - `raytpu profile --seconds N` — fresh blocking capture on every proc.
+    - `--trace ID` one request's per-trace fold; `--node ID` one node.
+    - `raytpu profile render FOLD.json` — offline: fold JSON (from --out
+      ... --json or an incident dump's "profile" key) to collapsed-stack
+      text (or a d3 tree with --json). Never connects.
+    - `raytpu profile IP:PORT` / `--worker IP:PORT` — legacy single-worker
+      py-spy-style capture.
+    """
+    import json as _json
+
+    from ray_tpu.obs import profiler as _profiler
+
+    if args.target == "render":
+        if not args.fold_json:
+            raise SystemExit("usage: raytpu profile render FOLD.json [--json] [--out F]")
+        with open(args.fold_json) as f:
+            fold = _json.load(f)
+        if "profile" in fold and "stacks" not in fold:
+            fold = fold["profile"]  # incident/flight dump wrapper
+        text = (_json.dumps(_profiler.to_tree(fold), indent=1) if args.json
+                else _profiler.to_collapsed(fold))
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+            print(f"wrote {args.out}")
+        else:
+            print(text, end="")
+        return
+
+    rt = _connect(args.address)
+    worker_addr = args.worker or (args.target if ":" in args.target else "")
+    if worker_addr:
+        from ray_tpu.core.api import profile_worker
+
+        prof = profile_worker(worker_addr, args.duration)
+        top = sorted(prof["stacks"].items(), key=lambda kv: -kv[1])[: args.top]
+        print(f"{prof['samples']} samples over {prof['duration_s']}s:")
+        depth = max(0, args.depth)
+        for stack, count in top:
+            frames = stack.split(";")
+            print(f"  {count:6d}  {frames[-1]}")
+            for f in reversed(frames[:-1][-depth:] if depth else []):
+                print(f"          ^ {f}")
+        return
+
+    from ray_tpu import obs
+
+    fold = obs.profile_cluster(window_s=args.window, seconds=args.seconds,
+                               trace_id=args.trace, node_id=args.node)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(_json.dumps(fold) if args.json
+                    else _profiler.to_collapsed(fold))
+        print(f"wrote {args.out}")
+        return
+    if args.json:
+        print(_json.dumps(fold, indent=1))
+        return
+    _print_fold(fold, args)
 
 
 def main(argv=None):
@@ -221,7 +288,22 @@ def main(argv=None):
     dr.add_argument("node_id")
     dr.add_argument("--undo", action="store_true", help="reopen the node")
     pr = sub.add_parser("profile")
-    pr.add_argument("worker_addr", help="worker IP:PORT (see `list actors`)")
+    pr.add_argument("target", nargs="?", default="",
+                    help="'render' for offline fold rendering, a worker "
+                         "IP:PORT for legacy single-worker capture, or "
+                         "omitted for the merged cluster flamegraph")
+    pr.add_argument("fold_json", nargs="?", default="",
+                    help="fold JSON path (render mode only)")
+    pr.add_argument("--seconds", type=float, default=None,
+                    help="fresh blocking capture window instead of the ring")
+    pr.add_argument("--window", type=float, default=60.0,
+                    help="ring lookback seconds (default 60)")
+    pr.add_argument("--trace", default="", help="per-trace fold for one request")
+    pr.add_argument("--node", default="", help="restrict to one node id prefix")
+    pr.add_argument("--worker", default="", help="legacy worker IP:PORT capture")
+    pr.add_argument("--json", action="store_true",
+                    help="raw fold JSON (render mode: d3 tree JSON)")
+    pr.add_argument("--out", default="", help="write instead of printing")
     pr.add_argument("--duration", type=float, default=2.0)
     pr.add_argument("--top", type=int, default=10)
     pr.add_argument("--depth", type=int, default=4)

@@ -450,6 +450,10 @@ def _scn_overload_storm(seed: int, quick: bool) -> dict:
     from ray_tpu.core.api import Cluster, init
 
     cfg = _fresh_config()
+    # This scenario asserts the burn-alert -> incident-flamegraph chain, so
+    # samplers must be armed even where the harness disarms them by default
+    # (tests/conftest.py sets RAYTPU_PROFILE_HZ=0 for unrelated suites).
+    cfg.profile_hz = 19.0
     # Tight AIMD knobs so the limit converges inside the scenario window.
     cfg.qos_target_delay_s = 0.08
     cfg.qos_min_concurrency = 2
@@ -617,6 +621,35 @@ def _scn_overload_storm(seed: int, quick: bool) -> dict:
     ]
     _require(any(e.get("state") == "alert" for e in slo_events),
              f"no slo_state=alert event in the controller log: {slo_events}")
+
+    # -- the burn alert must have snapshotted an incident profile ---------
+    # (ISSUE 19: alert-triggered capture — the merged cluster flamegraph
+    # lands in the controller's registry, same data /api/profile?incidents=1
+    # serves, so the incident dump carries its own "what was burning".)
+    deadline = time.monotonic() + 20
+    incidents: list = []
+    got: dict = {}
+    while time.monotonic() < deadline:
+        got = core._run(core.controller.call("profile_incidents", {}))
+        incidents = [i for i in got.get("incidents", [])
+                     if i.get("objective") == "storm-availability"]
+        if incidents:
+            break
+        time.sleep(0.4)
+    _require(bool(incidents),
+             "burn alert never snapshotted an incident profile "
+             f"(suppressed={got.get('suppressed')}, dropped={got.get('dropped')})")
+    prof = incidents[0]["profile"]
+    _require(prof.get("samples", 0) > 0 and prof.get("stacks"),
+             f"incident flamegraph is empty: {prof.get('samples', 0)} samples")
+    _require(len(prof.get("procs") or []) >= 2,
+             f"not a merged cluster fold: procs={prof.get('procs')}")
+    # The storm's cost is attributable: sampled stacks cross the serve plane
+    # (proxy/replica frames render as ray_tpu/serve/... via the shared
+    # formatter — the hot path names the machinery under fire).
+    _require(any("ray_tpu/serve/" in s for s in prof["stacks"]),
+             "no serve-plane frames in the storm's merged flamegraph: "
+             f"planes={prof.get('planes')}")
     from ray_tpu.serve.handle import _reset_registry
 
     _reset_registry()  # park router threads before the invariant battery

@@ -220,6 +220,23 @@ class Config:
     # Controller SLO evaluation cadence: each tick samples the merged
     # reporter series into every objective's window and re-judges burn rates.
     slo_eval_interval_s: float = 1.0
+    # Continuous wall-clock sampler (obs/profiler.py): every core process
+    # runs a daemon thread walking sys._current_frames at this rate, folding
+    # stacks into a bounded counted accumulator with per-plane attribution.
+    # ~19 Hz by default (prime-ish: never phase-locks onto 10/20/50ms
+    # periodic work); 0 disarms the sampler everywhere (RAYTPU_PROFILE_HZ).
+    profile_hz: float = 19.0
+    # Distinct collapsed stacks each accumulator retains; overflow drops the
+    # incoming stack's samples, counted (samples_dropped / stacks_evicted).
+    profile_max_stacks: int = 2048
+    # Window ring: the sampler folds finished epochs of profile_epoch_s into
+    # a bounded ring of profile_window_epochs (alert-triggered captures and
+    # /api/profile's default view read this window, not all-time totals).
+    profile_epoch_s: float = 5.0
+    profile_window_epochs: int = 24
+    # Per-trace profile scopes held per process (trace-id -> accumulator,
+    # oldest evicted counted). Populated only for TRACED exec spans.
+    profile_max_traces: int = 64
     # --- security ---
     # OPT-IN per-session shared secret for the RPC layer (pickle-over-TCP
     # executes code on unpickle; with a token set, every frame carries an

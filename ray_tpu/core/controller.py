@@ -32,6 +32,7 @@ from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.obs import _merge_events as _merge_trace_events
 from ray_tpu.obs import autopsy as _autopsy
 from ray_tpu.obs import flight as _flight
+from ray_tpu.obs import profiler as _profiler
 from ray_tpu.obs import slo as _slo
 from ray_tpu.util import tracing as _tracing
 from ray_tpu.util.bgtasks import spawn_bg as _spawn_bg_task
@@ -221,6 +222,16 @@ class Controller:
         self.flight_dumps: list[dict] = []
         self.flight_dumps_dropped = 0  # dump records lost to the registry bound
         self.MAX_FLIGHT_DUMPS = 256
+        # Alert-triggered profile captures: on an SLO burn ALERT the
+        # controller snapshots a merged cluster flamegraph here (the
+        # incident carries its own cost attribution). One capture per
+        # objective per limiter window — rate-limited exactly like flight
+        # dumps, so a flapping alert cannot turn the profiler into the
+        # incident. Bounded, counted.
+        self.incident_profiles: list[dict] = []
+        self.incident_profiles_dropped = 0
+        self.MAX_INCIDENT_PROFILES = 32
+        self._profile_limiter = _profiler.CaptureLimiter(min_interval_s=2.0)
         self.slo_engine = _slo.SloEngine()
         if config.slo_spec:
             self._load_slo_spec(config.slo_spec)
@@ -325,6 +336,12 @@ class Controller:
                             burn_slow=row["burn_slow"])
                 if row["state"] == _slo.ALERT:
                     self._stamp_slo_alert(now, row)
+                    # Incident capture: snapshot the cluster's recent
+                    # profile window so the burn alert carries its own
+                    # flamegraph. Fires once per alert transition, rate-
+                    # limited per objective like flight dumps.
+                    self._spawn_bg(self._capture_incident_profile(row),
+                                   name="slo-profile-capture")
 
     def _stamp_slo_alert(self, now: float, row: dict):
         """Append one alert point-event inside every recently-active indexed
@@ -341,6 +358,31 @@ class Controller:
                 t["events"].append(ev)
             else:
                 t["dropped"] += 1
+
+    async def _capture_incident_profile(self, row: dict):
+        """Snapshot a merged cluster flamegraph for one SLO burn alert into
+        the bounded incident registry. EXACTLY once per alert transition:
+        the FSM only yields rows on state changes, and the per-objective
+        limiter (flight-dump discipline) absorbs flapping."""
+        name = row["objective"]["name"]
+        if not self._profile_limiter.allow(name):
+            return
+        try:
+            merged = await self.handle_profile_collect(
+                None, {"window_s": 60.0, "max_stacks": 512})
+        except Exception:
+            logger.exception("incident profile capture failed (%s)", name)
+            return
+        rec = {"ts": _tracing.now(), "objective": name, "state": row["state"],
+               "burn_fast": row.get("burn_fast"), "profile": merged}
+        self.incident_profiles.append(rec)
+        if len(self.incident_profiles) > self.MAX_INCIDENT_PROFILES:
+            trimmed = len(self.incident_profiles) - self.MAX_INCIDENT_PROFILES
+            self.incident_profiles_dropped += trimmed
+            del self.incident_profiles[:trimmed]
+        self._event("profile_capture", objective=name,
+                    samples=merged.get("samples", 0),
+                    procs=len(merged.get("procs") or []))
 
     # -- persistence (control-plane fault tolerance) --------------------
     async def _snapshot_loop(self):
@@ -1145,6 +1187,73 @@ class Controller:
         out = self._truncate(list(reversed(self.flight_dumps)), int(p.get("limit", 50)))
         out["dumps"] = out.pop("items")
         out["dropped"] = self.flight_dumps_dropped
+        return out
+
+    async def handle_profile_collect(self, conn, p):
+        """Cluster profile collection (/api/profile, `raytpu profile`, the
+        incident capture): fan out to every live daemon — each fans out to
+        ITS workers, memory_summary-style — add the head process's own leg,
+        and merge the per-proc folds into one cluster flamegraph (bounded,
+        counted evictions; merge_folds dedups by proc id, which is what
+        keeps in-process heads from double counting). ``status`` mode
+        aggregates sampler status rows instead of merging folds; ``node_id``
+        restricts the fan-out to one node."""
+        req = {k: p[k] for k in ("status", "trace_id", "seconds", "window_s")
+               if k in p}
+        seconds = float(p.get("seconds") or 0.0)
+        node_filter = p.get("node_id") or ""
+
+        async def one(node: NodeRecord):
+            try:
+                return await asyncio.wait_for(
+                    node.conn.call("profile_fold", req),
+                    timeout=seconds + 15.0)
+            except Exception as e:
+                return {"folds": [], "errors": [
+                    f"{node.node_id[:8]}: {type(e).__name__}: {e}"]}
+
+        live = [
+            n for n in self.nodes.values()
+            if n.state == "ALIVE" and n.conn is not None and not n.conn.closed
+            and (not node_filter or n.node_id.startswith(node_filter))
+        ]
+        own_future = None
+        if not node_filter:
+            # The head's own leg runs concurrently with the fan-out (a
+            # `seconds` capture is a real wall-clock window on every proc).
+            loop = asyncio.get_running_loop()
+            own_future = loop.run_in_executor(
+                None, lambda: _profiler.local_fold(req))
+        replies = await asyncio.gather(*(one(n) for n in live))
+        folds: list = []
+        errors: list[str] = []
+        for r in replies:
+            folds.extend(r.get("folds") or [])
+            errors.extend(r.get("errors") or [])
+        if own_future is not None:
+            folds.append(await own_future)
+        if p.get("status"):
+            rows = [r for r in folds if isinstance(r, dict)]
+            return {"statuses": rows,
+                    "aggregate": _profiler.aggregate_status(rows),
+                    "errors": errors}
+        merged = _profiler.merge_folds(
+            folds, max_stacks=int(p.get("max_stacks") or
+                                  _profiler.DEFAULT_MAX_STACKS))
+        for k in ("window_s", "seconds", "trace_id"):
+            if k in p:
+                merged[k] = p[k]
+        merged["errors"] = errors
+        return merged
+
+    def handle_profile_incidents(self, conn, p):
+        """Alert-triggered capture registry: merged cluster flamegraphs
+        snapshotted on SLO burn alerts (newest first, bounded, counted)."""
+        out = self._truncate(list(reversed(self.incident_profiles)),
+                             int(p.get("limit", 10)))
+        out["incidents"] = out.pop("items")
+        out["dropped"] = self.incident_profiles_dropped
+        out["suppressed"] = self._profile_limiter.suppressed
         return out
 
     async def handle_collect_flight_trace(self, conn, p):

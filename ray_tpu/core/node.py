@@ -238,6 +238,19 @@ class NodeDaemon:
             # an identical spec, so controller-restart re-registration does
             # not reset live hit counters).
             _chaos.install_from_json(self.config.chaos_spec)
+        # Continuous profiler: a standalone daemon process samples itself
+        # too (it is not behind any worker). Idempotent when co-resident
+        # with a driver that already armed this process's sampler; the proc
+        # label is left alone so dedup-by-proc stays stable.
+        from ray_tpu.obs import profiler as _profiler
+
+        _profiler.arm(
+            hz=self.config.profile_hz,
+            max_stacks=self.config.profile_max_stacks,
+            epoch_s=self.config.profile_epoch_s,
+            window_epochs=self.config.profile_window_epochs,
+            max_traces=self.config.profile_max_traces,
+        )
 
     async def _heartbeat_loop(self):
         from ray_tpu.accel.tpu import preemption_notice
@@ -540,6 +553,42 @@ class NodeDaemon:
             except Exception:
                 continue
         return {"events": events, "sources": sources}
+
+    async def handle_profile_fold(self, conn, p):
+        """Per-node leg of cluster profile collection: this daemon process's
+        own fold (or status row) plus every live worker's, fanned out the
+        flight_trace way. Returns the per-proc list UNMERGED — the top of
+        the fan-in dedups by proc id, which is what keeps in-process
+        topologies (daemon co-resident with the head/driver) from double
+        counting a shared sampler."""
+        from ray_tpu.obs import profiler as _profiler
+
+        req = {k: p[k] for k in ("status", "trace_id", "seconds", "window_s")
+               if k in p}
+        seconds = float(p.get("seconds") or 0.0)
+        if seconds:
+            loop = asyncio.get_running_loop()
+            own = await loop.run_in_executor(
+                None, lambda: _profiler.local_fold(req))
+        else:
+            own = _profiler.local_fold(req)
+        errors: list[str] = []
+
+        async def one(w):
+            # Concurrent: a `seconds` capture runs on every worker at once
+            # (serial fan-out would stack the capture windows end to end).
+            try:
+                return await asyncio.wait_for(
+                    w.conn.call("profile_fold", req), timeout=seconds + 10.0)
+            except Exception as e:
+                errors.append(f"{w.worker_id[:8]}: {type(e).__name__}: {e}")
+                return None
+
+        live = [w for w in self.workers.values()
+                if w.conn is not None and not w.conn.closed and w.state != "DEAD"]
+        folds = [own] + [f for f in await asyncio.gather(*(one(w) for w in live))
+                         if f is not None]
+        return {"folds": folds, "errors": errors}
 
     async def _acquire_worker(self, renv: Optional[dict] = None) -> WorkerRecord:
         env_vars, pypath, cwd, env_hash, python_exe, container = await self._materialize_env(renv)

@@ -56,6 +56,7 @@ from ray_tpu.core import task_state as _ts
 from ray_tpu.core.task_spec import ActorSpec, TaskOptions, TaskSpec, scheduling_key
 from ray_tpu.obs import flight as _flight
 from ray_tpu.obs import health as _obs_health
+from ray_tpu.obs import profiler as _profiler
 from ray_tpu.qos import context as _qos
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
@@ -755,6 +756,18 @@ class CoreWorker:
                 spike_s=cfg.obs_loop_spike_s,
             )
             self._bg.append(asyncio.create_task(self._loop_probe.run()))
+        # Continuous profiler: arm (or disarm, hz<=0) THIS process's sampler
+        # with the adopted config. Also installs the tracing profile hook so
+        # traced exec spans get per-trace accumulators. Idempotent across
+        # controller reconnects.
+        _profiler.arm(
+            hz=cfg.profile_hz,
+            proc=self.worker_id[:12],
+            max_stacks=cfg.profile_max_stacks,
+            epoch_s=cfg.profile_epoch_s,
+            window_epochs=cfg.profile_window_epochs,
+            max_traces=cfg.profile_max_traces,
+        )
 
     async def _controller_handshake(self, conn):
         for channel in self._pub_handlers:
@@ -851,6 +864,16 @@ class CoreWorker:
         if fr.dumps_written:
             rec("flight.dumps_written", "counter", fr.dumps_written, {},
                 "flight-recorder dumps written by this process")
+        ps = _profiler.status()
+        if ps["samples"]:
+            rec("profile.samples", "counter", ps["samples"], {},
+                "wall-clock sampler stacks folded by this process")
+        if ps["samples_dropped"]:
+            rec("profile.samples_dropped", "counter", ps["samples_dropped"], {},
+                "sampler stacks rejected by the bounded distinct-stack table")
+        # Device-side cost gauges: jax local_devices() memory stats, gated
+        # hard (never imports jax; CPU backends report None and emit nothing).
+        out.extend(_profiler.device_memory_records(now))
         if _STREAM_BATCH_HIST:
             # Streamed-item batch-size histogram (owner side): how many items
             # each generator_items frame carried — the live-cluster view of
@@ -2629,37 +2652,34 @@ class CoreWorker:
         return self.store.path if self.store is not None else ""
 
     async def handle_profile_cpu(self, conn, p):
-        """On-demand CPU profile of THIS worker: sample every thread's stack
-        for `duration_s`, return collapsed stacks with counts (the dashboard's
+        """On-demand CPU profile of THIS worker (the dashboard's
         py-spy-equivalent, reference: dashboard/modules/reporter/
-        profile_manager.py:60-100 — here in-process via sys._current_frames,
-        no external profiler binary). Runs on an executor thread so the IO
-        loop keeps serving while sampling."""
+        profile_manager.py:60-100 — here in-process via sys._current_frames).
+        Routed through the obs.profiler capture-session API (one entry point,
+        session-bounded, shared frame rendering with every other profile
+        surface); runs on an executor thread so the IO loop keeps serving
+        while sampling. Reply keeps the original shape plus the fold's
+        plane/drop counters."""
         duration = min(float(p.get("duration_s", 2.0)), 30.0)
-        interval = max(float(p.get("interval_s", 0.01)), 0.001)
-
-        def sample():
-            import sys
-            import traceback as tb
-
-            counts: dict[str, int] = {}
-            end = time.monotonic() + duration
-            n = 0
-            while time.monotonic() < end:
-                for tid, frame in sys._current_frames().items():
-                    if tid == threading.get_ident():
-                        continue  # the sampler itself
-                    stack = ";".join(
-                        f"{f.name} ({f.filename.rsplit('/', 1)[-1]}:{f.lineno})"
-                        for f in tb.extract_stack(frame)
-                    )
-                    counts[stack] = counts.get(stack, 0) + 1
-                n += 1
-                time.sleep(interval)
-            return {"samples": n, "duration_s": duration, "stacks": counts}
+        hz = None
+        if p.get("interval_s"):
+            hz = 1.0 / max(float(p["interval_s"]), 0.005)
 
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, sample)
+        fold = await loop.run_in_executor(
+            None, lambda: _profiler.capture(duration, hz=hz))
+        return fold
+
+    async def handle_profile_fold(self, conn, p):
+        """This process's leg of cluster profile collection (controller ->
+        daemon -> worker fan-out, memory_summary-style). Modes (first match):
+        ``status`` -> sampler status row; ``trace_id`` -> that trace's
+        accumulator; ``seconds`` -> live bounded capture (executor thread);
+        ``window_s`` -> recent-window fold; default -> since-arm totals."""
+        if p.get("seconds"):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, lambda: _profiler.local_fold(p))
+        return _profiler.local_fold(p)
 
     def handle_dag_shm_ack(self, conn, p):
         from ray_tpu.dag.runtime import dag_shm_ack
@@ -2786,6 +2806,7 @@ class CoreWorker:
             "events_dropped": self._events_dropped,
             "tail": self.task_events[-tail:] if tail > 0 else [],
             "flight": _flight.recorder().stats(),
+            "profiler": _profiler.status(),
         }
 
     def handle_flight_dump(self, conn, p):

@@ -32,7 +32,9 @@ h3{margin-bottom:0.1em}.muted{color:#777;font-size:0.85em}
 <a href=/api/flight>flight&nbsp;dumps</a> ·
 <a href=/api/jobs>jobs</a> · <a href=/metrics>prometheus</a> ·
 task filters: <code>/api/tasks?state=RUNNING&fn=NAME&node=ID&limit=50</code> ·
-profile a worker: <code>/api/profile?addr=IP:PORT&duration=2</code> ·
+cluster flamegraph: <code>/api/profile</code>
+(<code>?fmt=collapsed</code>, <code>?summary=1</code>, <code>?incidents=1</code>,
+<code>?trace=TRACE_ID</code>, <code>?seconds=N</code>) ·
 trace search: <code>/api/traces?q=NAME</code>, one trace: <code>/api/traces?id=TRACE_ID</code> ·
 critical path: <code>/api/traces?id=TRACE_ID&autopsy=1</code></div>
 <h3>Nodes</h3><table id=nodes></table>
@@ -71,16 +73,39 @@ def _payload(path: str):
 
     core = api._require_worker()
     if path.startswith("/api/profile"):
-        # On-demand CPU profile of a running worker (reference: dashboard
-        # reporter's py-spy endpoint, profile_manager.py:60-100).
+        # Continuous-profiling plane. Default: merged cluster flamegraph
+        # from every process's always-on sampler ring (last ?window=S
+        # seconds, default 60). ?seconds=N runs a fresh blocking capture,
+        # ?trace=ID fetches one request's per-trace fold, ?summary=1 the
+        # sampler status rollup, ?incidents=1 the alert-triggered captures.
+        # ?fmt=collapsed renders flamegraph.pl collapsed-stack text,
+        # ?fmt=tree a d3-flame-graph JSON tree. Legacy per-worker py-spy
+        # style capture stays on ?addr=IP:PORT&duration=2.
         from urllib.parse import parse_qs, urlsplit
 
-        q = parse_qs(urlsplit(path).query)
-        addr = (q.get("addr") or [""])[0]
-        if not addr:
-            return {"error": "pass ?addr=IP:PORT (see /api/cluster actors)"}
-        duration = float((q.get("duration") or ["2.0"])[0])
-        return api.profile_worker(addr, duration)
+        from ray_tpu import obs as _obs
+        from ray_tpu.obs import profiler as _profiler
+
+        q = {k: v[0] for k, v in parse_qs(urlsplit(path).query).items()}
+        if q.get("addr"):
+            return api.profile_worker(q["addr"], float(q.get("duration", 2.0)))
+        if q.get("summary") not in (None, "", "0"):
+            return _obs.profile_status()
+        if q.get("incidents") not in (None, "", "0"):
+            return _obs.profile_incidents()
+        fold = _obs.profile_cluster(
+            window_s=float(q.get("window", 60.0)),
+            seconds=float(q["seconds"]) if q.get("seconds") else None,
+            trace_id=q.get("trace", ""),
+            node_id=q.get("node", ""),
+            max_stacks=int(q.get("max_stacks", 0)),
+        )
+        fmt = q.get("fmt", "")
+        if fmt == "collapsed":
+            return (_profiler.to_collapsed(fold), "text/plain")
+        if fmt == "tree":
+            return _profiler.to_tree(fold)
+        return fold
     if path.startswith(("/api/tasks", "/api/actors", "/api/objects", "/api/summary")):
         # State API passthrough (reference: dashboard state-api routes).
         # Filters ride the query string: ?state=RUNNING&node=..&fn=..&job=..
@@ -200,7 +225,10 @@ class _Handler(BaseHTTPRequestHandler):
                 if data is None:
                     self.send_error(404)
                     return
-                body, ctype = json.dumps(data, default=str).encode(), "application/json"
+                if isinstance(data, tuple):  # pre-rendered (text, ctype)
+                    body, ctype = data[0].encode(), data[1]
+                else:
+                    body, ctype = json.dumps(data, default=str).encode(), "application/json"
             self.send_response(200)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))

@@ -216,6 +216,19 @@ class FlightRecorder:
             "events": len(events),
             "events_evicted": evicted,
         }
+        # Incident dumps carry their own flamegraph: when the continuous
+        # sampler is armed, snapshot this process's recent profile window
+        # into the header (the qos.deadline_storm / worker.death post-mortem
+        # then says WHERE the cycles went, not just what happened). Lazy
+        # import: the profiler is optional context, never a dump dependency.
+        try:
+            from ray_tpu.obs import profiler as _profiler
+
+            prof = _profiler.window_fold_or_none()
+        except Exception:
+            prof = None
+        if prof is not None:
+            header["profile"] = prof
         try:
             with open(out, "w") as f:
                 f.write(json.dumps(header, default=str) + "\n")

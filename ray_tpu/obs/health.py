@@ -14,12 +14,10 @@ static cases; this catches the dynamic ones).
 from __future__ import annotations
 
 import asyncio
-import sys
-import threading
 import time
-import traceback
 
 from ray_tpu.obs import flight as _flight
+from ray_tpu.obs import stacks as _stacks
 from ray_tpu.util import metrics as _metrics
 
 # One histogram per process; bucket edges tuned for "scheduling jitter"
@@ -33,16 +31,10 @@ _SPIKE_MIN_INTERVAL_S = 5.0
 
 def thread_dump(max_frames: int = 12) -> list[dict]:
     """Compact stacks of every live thread (sys._current_frames), newest
-    frame last — what the flight recorder stores on a lag spike."""
-    names = {t.ident: t.name for t in threading.enumerate()}
-    out = []
-    for ident, frame in sys._current_frames().items():
-        stack = traceback.format_stack(frame)[-max_frames:]
-        out.append({
-            "thread": names.get(ident, str(ident)),
-            "stack": [line.strip() for line in stack],
-        })
-    return out
+    frame last — what the flight recorder stores on a lag spike. Walks and
+    renders through obs/stacks (the ONE stack formatter), so a lag-spike
+    dump and a profiler flamegraph name every frame identically."""
+    return _stacks.thread_dump(max_frames)
 
 
 class LoopLagProbe:

@@ -661,6 +661,19 @@ def cmd_status(args) -> None:
         alert = ",".join(slo["alert"]) or "-"
         burning = ",".join(slo["burning"]) or "-"
         print(f"slo: {slo['ok']}/{slo['total']} ok; alert: {alert}; burning: {burning}")
+    try:
+        # One line on the always-on sampler fleet (details: /api/profile
+        # ?summary=1 / `raytpu profile`). Best-effort: status must not
+        # fail because a daemon is mid-restart.
+        prof = core._run(core.controller.call("profile_collect", {"status": 1}))
+        agg = prof.get("aggregate") or {}
+    except Exception:
+        agg = {}
+    if agg.get("procs"):
+        print(f"profiler: {agg['armed']}/{agg['procs']} armed @ "
+              f"{agg.get('hz', 0):g}Hz; buffer {agg.get('occupancy', 0):.0%} "
+              f"({agg.get('stacks', 0)} stacks); "
+              f"{agg.get('samples_dropped', 0):g} samples dropped")
 
 
 def cmd_logs(args) -> None:

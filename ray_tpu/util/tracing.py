@@ -80,17 +80,52 @@ def current_trace() -> Optional[tuple]:
     return _ctx.get()
 
 
+# Per-trace profiling hook: (begin(trace_id) -> token, end(token)), installed
+# by obs.profiler.arm when the continuous sampler runs. activate/deactivate
+# bracket traced exec spans, so the sampler can attribute an executor
+# thread's samples to the trace it is serving — the cost contract holds:
+# untraced paths never reach the hook (activate(None) returns first), and
+# traced paths pay one extra global read when no profiler is armed.
+_prof_hook: Optional[tuple] = None
+
+
+def set_profile_hook(begin, end):
+    """Install (or clear, with begin=None) the per-trace profile scope hook.
+    Owner: obs.profiler — nothing else should call this."""
+    global _prof_hook
+    _prof_hook = (begin, end) if begin is not None else None
+
+
 def activate(ctx: Optional[tuple]):
     """Install a propagated (trace_id, span_id) as this thread's active
-    context; returns a token for ``deactivate``. None -> no-op (None token)."""
+    context; returns a token for ``deactivate``. None -> no-op (None token).
+    With a profiler armed, also opens the trace's profile scope on this
+    thread (the token carries the scope; deactivate closes it)."""
     if ctx is None:
         return None
-    return _ctx.set((ctx[0], ctx[1]))
+    tok = _ctx.set((ctx[0], ctx[1]))
+    hook = _prof_hook
+    if hook is None:
+        return tok
+    try:
+        ptok = hook[0](ctx[0])
+    except Exception:
+        return tok  # profiling must never break task execution
+    return (tok, hook[1], ptok)
 
 
 def deactivate(token):
-    if token is not None:
-        _ctx.reset(token)
+    if token is None:
+        return
+    if type(token) is tuple:  # (ctx token, profile end fn, profile token)
+        tok, end, ptok = token
+        try:
+            end(ptok)
+        except Exception:
+            pass
+        _ctx.reset(tok)
+        return
+    _ctx.reset(token)
 
 
 def _record_event(ev: dict):
@@ -345,19 +380,24 @@ def render_timeline(events: list[dict], path: str) -> int:
 def profile_tpu(logdir: str):
     """Capture a JAX profiler trace (XPlane; view in TensorBoard/Perfetto)
     around a block of device work — the TPU-native analogue of the
-    reference's on-demand py-spy/nsight profiling."""
-    import jax
+    reference's on-demand py-spy/nsight profiling.
 
-    jax.profiler.start_trace(logdir)
-    try:
+    Routed through the obs.profiler capture-session API (ONE entry point
+    for device profiling: session-bounded, visible in profiler status).
+    On a CPU-only host this raises obs.profiler.DeviceProfilerUnavailable
+    at entry — a typed, named refusal instead of an AttributeError or a
+    silent empty trace mid-capture."""
+    from ray_tpu.obs import profiler as _profiler
+
+    with _profiler.device_capture(logdir):
         yield
-    finally:
-        jax.profiler.stop_trace()
 
 
 def profile_server(port: int = 9012):
     """Start the JAX profiler server for on-demand remote capture
-    (TensorBoard 'capture profile' against this port)."""
-    import jax
+    (TensorBoard 'capture profile' against this port). Same typed-and-loud
+    backend gate as profile_tpu (obs.profiler.DeviceProfilerUnavailable on
+    hosts with no TPU/GPU backend)."""
+    from ray_tpu.obs import profiler as _profiler
 
-    return jax.profiler.start_server(port)
+    return _profiler.device_server(port)

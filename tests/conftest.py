@@ -14,6 +14,16 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAYTPU_OBJECT_STORE_MEMORY", str(64 * 1024 * 1024))
+# Disarm the always-on profiler for suites that don't exercise it: on the
+# 1-core CI box every armed process's 19 Hz frame-walk steals ~0.7% of the
+# one core, and a multi-node test runs ~10 processes — enough aggregate drag
+# (~15-20% measured on worker-heavy modules) to push tier-1 past its wall
+# budget. Profiler tests arm explicitly (profiler.arm(...) ignores the env;
+# cluster fixtures set cfg.profile_hz after apply_env), and chaos scenarios
+# that assert the alert->flamegraph chain pin cfg.profile_hz themselves, so
+# coverage of the armed path is unchanged. setdefault: export a nonzero
+# RAYTPU_PROFILE_HZ to run the whole suite armed.
+os.environ.setdefault("RAYTPU_PROFILE_HZ", "0")
 # Spawned workers must also land on CPU (their sitecustomize re-pins the
 # tunneled TPU backend regardless of JAX_PLATFORMS).
 os.environ["RAYTPU_FORCE_JAX_PLATFORM"] = "cpu"
