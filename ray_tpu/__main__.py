@@ -125,11 +125,37 @@ def cmd_slo(args):
 
 def cmd_debug(args):
     """Observability debug verbs. `debug dump <worker_addr>` asks one worker
-    to write a manual flight-recorder dump and prints where it landed."""
+    to write a manual flight-recorder dump and prints where it landed;
+    `debug obs <worker_addr>` prints the worker's ground-truth observability
+    snapshot (event counters, recorder ring, profiler status) so 'never
+    recorded' and 'never flushed' are distinguishable without waiting on
+    reporter ticks."""
     rt = _connect(args.address)
     from ray_tpu.core import api
 
     core = api._require_worker()
+
+    if args.debug_cmd == "obs":
+        async def go_obs():
+            conn = await core._peer_conn(args.worker_addr)
+            return await conn.call(
+                "debug_observability", {"tail": args.tail}, timeout=30)
+
+        out = core._run(go_obs())
+        fl = out.get("flight", {})
+        prof = out.get("profiler", {})
+        print(f"worker {out.get('worker_id', '?')}:")
+        print(f"  task events: {out.get('task_events_len', 0)} buffered, "
+              f"{out.get('events_reported', 0):g} reported, "
+              f"{out.get('events_dropped', 0):g} dropped")
+        print(f"  flight ring: {fl.get('len', '?')} held, "
+              f"{fl.get('events_evicted', 0):g} evicted, "
+              f"{fl.get('dumps_written', 0):g} dumps written")
+        print(f"  profiler: {'running' if prof.get('running') else 'stopped'} "
+              f"({prof.get('samples', 0):g} samples)")
+        for ev in out.get("tail", []):
+            print(f"  tail: {ev}")
+        return
 
     async def go():
         conn = await core._peer_conn(args.worker_addr)
@@ -314,6 +340,9 @@ def main(argv=None):
     dd = dsub.add_parser("dump", help="manual flight-recorder dump of one worker")
     dd.add_argument("worker_addr", help="worker IP:PORT (see `list workers`)")
     dd.add_argument("--reason", default="manual CLI dump")
+    do = dsub.add_parser("obs", help="ground-truth observability snapshot of one worker")
+    do.add_argument("worker_addr", help="worker IP:PORT (see `list workers`)")
+    do.add_argument("--tail", type=int, default=5, help="recent task events to include")
     tr = sub.add_parser("trace", help="trace reassembly from live flight recorders")
     trsub = tr.add_subparsers(dest="trace_cmd", required=True)
     te = trsub.add_parser("export", help="rebuild one trace, write a Perfetto timeline")

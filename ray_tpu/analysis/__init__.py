@@ -21,7 +21,14 @@ from ray_tpu.analysis.engine import (  # noqa: F401
     LintResult,
     Rule,
     Suppression,
+    analyze_source,
     default_rules,
     lint_paths,
     lint_source,
+    lint_sources,
+)
+from ray_tpu.analysis.index import ProjectIndex  # noqa: F401
+from ray_tpu.analysis.rules_xfile import (  # noqa: F401
+    ProjectRule,
+    default_project_rules,
 )

@@ -49,14 +49,13 @@ class ChaosGate(Rule):
         "unique site name — no ad-hoc chaos branches"
     )
 
-    def __init__(self):
-        # Site names live across files within one lint run: uniqueness is a
-        # TREE property (two call sites sharing a name are indistinguishable
-        # in schedules, logs, and metrics).
-        self._sites: dict = {}  # site -> "path:line"
-
     def begin_file(self, ctx: FileContext) -> None:
         self._aliases: set = set()  # names bound to the chaos module in this file
+        # Literal site names seen in THIS file only. Tree-wide uniqueness is
+        # checked in phase 2 (rules_xfile.ChaosSiteUnique) over the project
+        # index — cross-file state in a per-file rule would go blind the
+        # moment the parse cache serves one of the two duplicated files.
+        self._sites: set = set()
 
     def visit(self, node: ast.AST, ctx: FileContext) -> None:
         if isinstance(node, ast.ImportFrom):
@@ -134,21 +133,8 @@ class ChaosGate(Rule):
                 "name can't be cataloged, validated, or replayed",
             )
             return
-        site = node.args[0].value
-        where = f"{ctx.path}:{node.lineno}"
-        prior = self._sites.get(site)
-        if prior is not None and prior != where:
-            ctx.report(
-                self, node,
-                f"duplicate chaos site name {site!r} (first used at {prior}) — "
-                "site names are unique tree-wide so schedules and injection "
-                "logs identify exactly one code path",
-            )
-        else:
-            self._sites.setdefault(site, where)
+        self._sites.add(node.args[0].value)
 
     def end_file(self, ctx: FileContext) -> None:
         if self._sites:
-            ctx.stats.setdefault(self.id, {})["sites"] = sorted(
-                s for s, w in self._sites.items() if w.startswith(ctx.path + ":")
-            )
+            ctx.stats.setdefault(self.id, {})["sites"] = sorted(self._sites)

@@ -70,9 +70,15 @@ class ChunkStore:
 
     def __init__(self, root: str, chunk_size: int | None = None):
         if chunk_size is None:
+            from ray_tpu.core import api as _api
             from ray_tpu.core.config import get_config
 
-            chunk_size = get_config().ckpt_chunk_size
+            # Chunk writers run inside spawned workers: the ADOPTED cluster
+            # config, not get_config(), or a head-pushed ckpt_chunk_size
+            # would be invisible here (the PR-8 lesson).
+            core = getattr(_api, "_global_worker", None)
+            cfg = getattr(core, "config", None) or get_config()
+            chunk_size = cfg.ckpt_chunk_size
         self.chunk_size = int(chunk_size)
         self.dir = os.path.join(os.path.abspath(root), "chunks")
         os.makedirs(self.dir, exist_ok=True)

@@ -92,9 +92,15 @@ class WeightSubscriber:
                  poll_interval_s: Optional[float] = None,
                  storage_root: Optional[str] = None, auto_start: bool = True):
         if poll_interval_s is None:
+            from ray_tpu.core import api as _api
             from ray_tpu.core.config import get_config
 
-            poll_interval_s = get_config().ckpt_poll_interval_s
+            # Subscribers run inside replicas: the ADOPTED cluster config,
+            # not get_config(), or a head-pushed ckpt_poll_interval_s would
+            # be invisible here (the PR-8 lesson).
+            core = getattr(_api, "_global_worker", None)
+            cfg = getattr(core, "config", None) or get_config()
+            poll_interval_s = cfg.ckpt_poll_interval_s
         self.channel = channel
         self.swap_fn = swap_fn
         self.poll_interval_s = float(poll_interval_s)

@@ -540,20 +540,9 @@ def _check_rank(g: _GroupHandle, rank: int, what: str) -> None:
             f"in group {g.name!r}")
 
 
-def _is_float_dtype(dt) -> bool:
-    """True for any floating dtype INCLUDING ml_dtypes (bfloat16 registers
-    with numpy as kind 'V', so a bare ``dtype.kind == 'f'`` check silently
-    misclassifies the plane's flagship dtype)."""
-    dt = np.dtype(dt)
-    if dt.kind == "f":
-        return True
-    try:
-        import ml_dtypes
-
-        ml_dtypes.finfo(dt)
-        return True
-    except Exception:
-        return False
+# Hoisted to util.dtypes so every plane shares one predicate (graftlint's
+# dtype-kind rule machine-enforces that); the old name stays importable.
+from ray_tpu.util.dtypes import is_float_dtype as _is_float_dtype  # noqa: E402
 
 
 def _to_np(x):

@@ -501,10 +501,6 @@ class Controller:
         conn.on_close = self._make_close_cb(conn)
         return True
 
-    def handle_unsubscribe(self, conn, p):
-        self.subscribers.get(p["channel"], set()).discard(conn)
-        return True
-
     def handle_worker_logs(self, conn, p):
         """Fan worker stdout/stderr lines out to drivers subscribed to the
         ``logs`` channel (reference: log_monitor publishes through GCS pubsub
@@ -1500,10 +1496,6 @@ class Controller:
 
     def handle_kv_get(self, conn, p):
         return self.kv.get(p.get("ns", ""), {}).get(p["key"])
-
-    def handle_kv_multi_get(self, conn, p):
-        ns = self.kv.get(p.get("ns", ""), {})
-        return {k: ns.get(k) for k in p["keys"]}
 
     def handle_kv_del(self, conn, p):
         removed = self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None

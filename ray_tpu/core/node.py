@@ -911,24 +911,6 @@ class NodeDaemon:
                 self.store.release(oid)
         return {"ok": True, "tag": hasher.digest()[: rpc.FRAME_TAG_LEN] if hasher is not None else b""}
 
-    def handle_read_object_chunk(self, conn, p):
-        """Legacy pickled chunk read (pre-v3 pull path; kept for tooling and
-        as the raw lane's functional reference)."""
-        oid = ObjectID(p["oid"])
-        view = self.store.get(oid)
-        if view is None and self._restore_local(oid):
-            view = self.store.get(oid)
-        if view is None:
-            data = self._spilled_pread(oid, p["offset"], p["length"])
-            if data is not None:
-                return data
-            raise KeyError(f"object {oid.hex()} not in store")
-        try:
-            return bytes(view[p["offset"] : p["offset"] + p["length"]])
-        finally:
-            view.release()
-            self.store.release(oid)
-
     def handle_delete_objects(self, conn, p):
         for oid_bin in p["oids"]:
             oid = ObjectID(oid_bin)
@@ -948,12 +930,9 @@ class NodeDaemon:
             pass
 
     def _store_stats(self) -> dict:
-        """The one shape of this node's arena occupancy (heartbeat piggyback,
-        store_stats RPC, memory_summary) — add a stat here, not per caller."""
+        """The one shape of this node's arena occupancy (heartbeat piggyback
+        and memory_summary) — add a stat here, not per caller."""
         return {"capacity": self.store.capacity, "used": self.store.used, "num_objects": self.store.num_objects}
-
-    def handle_store_stats(self, conn, p):
-        return self._store_stats()
 
     async def handle_memory_summary(self, conn, p):
         """Per-node half of the cluster `ray memory` fan-out: this node's

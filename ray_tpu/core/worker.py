@@ -2739,9 +2739,6 @@ class CoreWorker:
         loop.call_soon(stop)
         return True
 
-    def handle_health_check(self, conn, p):
-        return {"ok": True, "worker_id": self.worker_id}
-
     def handle_memory_summary(self, conn, p):
         """Dump this process's ownership/reference picture (the `ray memory`
         per-worker unit, reference: CoreWorkerService.GetCoreWorkerStats ->

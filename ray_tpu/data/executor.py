@@ -535,10 +535,12 @@ def _stable_partition_ids(values, n_parts: int) -> "np.ndarray":
     the reduce's dict — they must land in the same partition)."""
     import zlib
 
+    from ray_tpu.util.dtypes import is_float_dtype
+
     arr = np.asarray(values)
     if arr.dtype.kind in "iu":  # integers partition directly
         return (arr % n_parts).astype(np.int64)
-    if arr.dtype.kind == "f":
+    if is_float_dtype(arr.dtype):
         as_int = arr.astype(np.int64, copy=False)
         # Integral floats route like ints (cross-dtype join consistency);
         # true fractional keys use the stable byte hash below.
@@ -548,7 +550,11 @@ def _stable_partition_ids(values, n_parts: int) -> "np.ndarray":
     def one(v):
         if isinstance(v, (int, np.integer)):
             return int(v) % n_parts
-        if isinstance(v, (float, np.floating)) and float(v).is_integer():
+        if isinstance(v, np.generic) and is_float_dtype(v.dtype):
+            # bf16/f32 scalars hash by repr ("-0", "np.float32(0.5)") unless
+            # canonicalized through the builtin float the pylist path yields.
+            v = float(v)
+        if isinstance(v, float) and v.is_integer():
             return int(v) % n_parts  # same route as the int fast path
         return zlib.crc32(repr(v).encode()) % n_parts
 

@@ -152,6 +152,14 @@ async def fetch(core, conn, p: dict) -> dict:
     all arrived sees its expect_raw futures resolve before the call does."""
     tid = p["tid"]
     dst = int(p["dst"])
+    token = _tracing.activate(tuple(p["tc"])) if p.get("tc") else None
+    try:
+        return await _fetch_inner(core, conn, p, tid, dst)
+    finally:
+        _tracing.deactivate(token)
+
+
+async def _fetch_inner(core, conn, p: dict, tid: str, dst: int) -> dict:
     with _LOCK:
         exp = _EXPORTS.get(tid)
     if exp is None:
@@ -245,13 +253,17 @@ async def _pull_from_source(core, addr: str, tid: str, dst_rank: int,
                 sl = mv[pi * part_bytes: min((pi + 1) * part_bytes, r.nbytes)]
                 k = _frame_key(tid, dst_rank, r.path, r.dst_off, pi)
                 pending.append((k, conn.expect_raw(k, sl)))
+        payload = {
+            "tid": tid, "dst": dst_rank,
+            "items": [{"path": r.path, "src_off": r.src_off,
+                       "dst_off": r.dst_off, "nbytes": r.nbytes}
+                      for r in runs],
+        }
+        tc = _tracing.current_trace()
+        if tc is not None:
+            payload["tc"] = tc  # source-side frames join the reshard trace
         reply = await asyncio.wait_for(
-            conn.call("elastic_fetch", {
-                "tid": tid, "dst": dst_rank,
-                "items": [{"path": r.path, "src_off": r.src_off,
-                           "dst_off": r.dst_off, "nbytes": r.nbytes}
-                          for r in runs],
-            }, timeout=timeout),
+            conn.call("elastic_fetch", payload, timeout=timeout),
             timeout + 5.0)
         if not reply.get("ok"):
             raise ElasticTransferError(

@@ -54,7 +54,7 @@ def autopsy(events: list[dict]) -> dict:
     spans = _span_events(events)
     root = None
     for s in spans:
-        if s.get("name") == "serve.request":
+        if s.get("name") == "serve.request":  # graftlint: disable=metric-contract  serve.request is the root SPAN name (tracing.span in serve/replica.py), not a metric series
             root = s
             break
     if root is None and spans:

@@ -133,7 +133,7 @@ class BucketedGradSync:
         works queued to poison the next step's finish() (the ring itself
         recovers; a retried step pushes fresh grads)."""
         from ray_tpu import collective as col
-        from ray_tpu.collective.collective import _is_float_dtype
+        from ray_tpu.util.dtypes import is_float_dtype as _is_float_dtype
 
         self._flush()
         world = col.get_collective_group_size(self.group_name)

@@ -1,0 +1,27 @@
+"""Shared dtype predicates.
+
+The one that matters: ``is_float_dtype``. numpy reports ml_dtypes types
+(bfloat16 above all — the plane's flagship dtype) as kind 'V', so a bare
+``dtype.kind == "f"`` check silently misclassifies them; the collective
+plane shipped that bug live (PR 12, round 9) and graftlint's ``dtype-kind``
+rule now keeps every such check routed through here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def is_float_dtype(dt) -> bool:
+    """True for any floating dtype INCLUDING ml_dtypes (bfloat16 registers
+    with numpy as kind 'V', so a bare ``dtype.kind == 'f'`` check silently
+    misclassifies it)."""
+    dt = np.dtype(dt)
+    if dt.kind == "f":
+        return True
+    try:
+        import ml_dtypes
+
+        ml_dtypes.finfo(dt)
+        return True
+    except Exception:
+        return False
